@@ -1,0 +1,30 @@
+"""simple_distributed_machine_learning_tpu — a TPU-native distributed training framework.
+
+A brand-new, SPMD-first rebuild of the capabilities of
+``maduc238/simple_distributed_machine_learning`` (a 2-process pipeline-model-parallel
+trainer built on torch.distributed.rpc; see ``/root/reference/simple_distributed.py``):
+
+- the reference's TensorPipe RPC bootstrap (``simple_distributed.py:167-186``) becomes
+  :func:`jax.distributed.initialize` behind the same CLI (``cli.py``);
+- its blocking activation/gradient RPC hops (``simple_distributed.py:49,:112``) become
+  ``lax.ppermute`` collective-permutes over ICI inside a single compiled step
+  (``parallel/pipeline.py``);
+- its DistributedOptimizer owner-local SGD (``simple_distributed.py:100-104,:113``)
+  becomes sharding-local updates on a stage-sharded parameter buffer
+  (``train/optimizer.py``);
+- its master/worker MPMD layout becomes one SPMD program over a
+  ``jax.sharding.Mesh`` with ``(data, stage)`` axes (``parallel/mesh.py``).
+
+Subpackages
+-----------
+``ops``       functional NN kernels (conv/pool/linear/dropout/losses, attention)
+``parallel``  mesh construction, collectives, the pipeline engine (GPipe schedule)
+``models``    MLP / LeNet / tiny-GPT expressed as pipeline stages
+``train``     optimizers, train/eval driver, checkpointing
+``data``      MNIST (IDX files or synthetic fallback), batching
+``utils``     metrics, timing, logging
+"""
+
+__version__ = "0.1.0"
+
+from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh  # noqa: F401

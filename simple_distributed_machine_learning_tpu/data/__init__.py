@@ -1,0 +1,1 @@
+"""Data pipeline: MNIST (IDX files or deterministic synthetic fallback)."""

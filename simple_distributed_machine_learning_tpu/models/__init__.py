@@ -1,0 +1,8 @@
+"""Model zoo, expressed as pipeline stages (see ``parallel.pipeline.Stage``).
+
+Scope per BASELINE.json configs: N-layer MLPs (2- and 4-stage pipelines),
+LeNet with the reference's conv↔fc split, and a tiny GPT with GPipe
+microbatching.
+"""
+
+from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages  # noqa: F401

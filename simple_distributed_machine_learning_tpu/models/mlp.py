@@ -1,0 +1,60 @@
+"""MLP as a pipeline of linear(+relu) stages, ending in log_softmax.
+
+This is BASELINE.json config 1/2/3: a 2-stage split (stage0=fc1, stage1=fc2)
+generalized to N layers over S stages. It is the minimal end-to-end slice of
+the framework (SURVEY §7) — same stage/wire machinery as LeNet and GPT, no
+convs or attention.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from simple_distributed_machine_learning_tpu.ops.layers import linear, linear_init, relu
+from simple_distributed_machine_learning_tpu.ops.losses import log_softmax
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Stage
+
+
+def make_mlp_stages(key: jax.Array, dims: Sequence[int], n_stages: int
+                    ) -> tuple[list[Stage], int, int]:
+    """Build an MLP ``dims[0] -> ... -> dims[-1]`` split into ``n_stages``.
+
+    Layers are assigned contiguously to stages (earlier stages take the
+    remainder). Hidden activations are relu; the final layer ends in
+    log_softmax (matching the reference model family's output convention,
+    ``/root/reference/simple_distributed.py:79``).
+
+    Returns ``(stages, wire_dim, out_dim)``.
+    """
+    n_layers = len(dims) - 1
+    if n_layers < n_stages:
+        raise ValueError(f"{n_layers} layers cannot fill {n_stages} stages")
+    keys = jax.random.split(key, n_layers)
+    layer_params = [linear_init(keys[i], dims[i], dims[i + 1])
+                    for i in range(n_layers)]
+    per = [n_layers // n_stages + (1 if i < n_layers % n_stages else 0)
+           for i in range(n_stages)]
+
+    stages: list[Stage] = []
+    start = 0
+    for s in range(n_stages):
+        params = layer_params[start:start + per[s]]
+        is_last = s == n_stages - 1
+
+        def apply(params, x, key, deterministic,
+                  _n=len(params), _last=is_last):
+            h = x
+            for i, p in enumerate(params):
+                h = linear(p, h)
+                if i < _n - 1 or not _last:
+                    h = relu(h)
+            return log_softmax(h) if _last else h
+
+        stages.append(Stage(apply=apply, params=params,
+                            in_shape=(dims[start],)))
+        start += per[s]
+
+    wire_dim = max(dims)
+    return stages, wire_dim, dims[-1]

@@ -1,0 +1,24 @@
+"""Functional NN ops: pure functions over explicit params and RNG keys.
+
+TPU-native replacement for the ATen kernels the reference invokes through
+``torch.nn`` / ``torch.nn.functional`` (``/root/reference/simple_distributed.py:42-46,
+:75-79``). Everything here lowers to XLA:TPU HLO; layouts are chosen for the MXU
+(NHWC convs, ``[in, out]`` matmul weights).
+"""
+
+from simple_distributed_machine_learning_tpu.ops.layers import (  # noqa: F401
+    conv2d,
+    conv2d_init,
+    dropout,
+    dropout2d,
+    linear,
+    linear_init,
+    max_pool2d,
+    relu,
+)
+from simple_distributed_machine_learning_tpu.ops.losses import (  # noqa: F401
+    accuracy,
+    log_softmax,
+    nll_loss,
+    softmax_cross_entropy,
+)

@@ -1,0 +1,47 @@
+"""Loss and metric ops (``F.log_softmax`` / ``F.nll_loss`` equivalents).
+
+The reference computes ``log_softmax`` on the last pipeline stage
+(``/root/reference/simple_distributed.py:79``) and ``nll_loss`` on the master
+(``:111``, mean reduction; ``:126`` sum reduction via the deprecated
+``size_average=False``). Here both reductions are explicit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def log_softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def nll_loss(log_probs: jax.Array, targets: jax.Array,
+             reduction: str = "mean") -> jax.Array:
+    """Negative log likelihood of integer ``targets`` under ``log_probs``.
+
+    log_probs: [..., C] (already log-probabilities), targets: [...] int.
+    """
+    picked = jnp.take_along_axis(
+        log_probs, targets[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    losses = -picked
+    if reduction == "mean":
+        return jnp.mean(losses)
+    if reduction == "sum":
+        return jnp.sum(losses)
+    if reduction == "none":
+        return losses
+    raise ValueError(f"unknown reduction: {reduction!r}")
+
+
+def softmax_cross_entropy(logits: jax.Array, targets: jax.Array,
+                          reduction: str = "mean") -> jax.Array:
+    """Cross entropy from raw logits (= nll_loss ∘ log_softmax, fused)."""
+    return nll_loss(log_softmax(logits), targets, reduction=reduction)
+
+
+def accuracy(log_probs_or_logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Fraction of rows whose argmax matches ``targets`` (reference ``:127-128``)."""
+    pred = jnp.argmax(log_probs_or_logits, axis=-1)
+    return jnp.mean((pred == targets).astype(jnp.float32))

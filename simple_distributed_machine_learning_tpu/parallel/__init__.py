@@ -1,0 +1,29 @@
+"""Parallelism core: mesh, collectives, stage packing, the pipeline engine.
+
+This package is the TPU-native replacement for the reference's entire
+communication/runtime layer — TensorPipe RPC transport, rendezvous store,
+distributed autograd, and RRef object layer
+(``/root/reference/simple_distributed.py:8-11,:33-37,:47-57,:109-113,:167-186``).
+In the SPMD design none of those survive as separate subsystems: rendezvous is
+``jax.distributed.initialize`` (``mesh.py``), the activation/grad hops are
+``lax.ppermute`` inside one compiled step (``pipeline.py``), backward through
+the hop is JAX autodiff transposing the permute, and "remote references"
+dissolve into sharded ``jax.Array`` placement.
+"""
+
+from simple_distributed_machine_learning_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    STAGE_AXIS,
+    make_mesh,
+)
+from simple_distributed_machine_learning_tpu.parallel.staging import (  # noqa: F401
+    StageMeta,
+    pack_stage_params,
+    unpack_stage_params,
+    wire_decode,
+    wire_encode,
+)
+from simple_distributed_machine_learning_tpu.parallel.pipeline import (  # noqa: F401
+    Pipeline,
+    Stage,
+)

@@ -1,0 +1,75 @@
+"""Device mesh construction and multi-host bootstrap.
+
+Replaces the reference's process bootstrap — argparse → env exports →
+``rpc.init_rpc`` rendezvous (``/root/reference/simple_distributed.py:139-186``)
+— with a ``jax.sharding.Mesh`` over the TPU slice and (for multi-host)
+``jax.distributed.initialize``. The mesh has two named axes:
+
+- ``"data"``  — data parallelism (batch sharding; grads all-reduced over ICI)
+- ``"stage"`` — pipeline parallelism (one pipeline stage per mesh slot;
+  activations hop stage→stage+1 via ``lax.ppermute``)
+
+Axis order is (data, stage) so that neighbouring pipeline stages are adjacent
+device ids — on a real slice that keeps the stage hop on the shortest ICI path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+STAGE_AXIS = "stage"
+
+
+def make_mesh(n_stages: int = 1, n_data: int | None = None,
+              devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a ``(data, stage)`` mesh from the available devices.
+
+    ``n_data`` defaults to ``len(devices) // n_stages`` so the whole slice is
+    used. The reference's topology was fixed at exactly 2 ranks with the peer
+    name hardcoded (``simple_distributed.py:34``); here the topology is derived
+    from the device list.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if n_data is None:
+        if len(devices) % n_stages != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {n_stages} "
+                f"pipeline stages (pass n_data to use a subset)")
+        n_data = len(devices) // n_stages
+    if n_data * n_stages > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_stages} needs {n_data * n_stages} devices, "
+            f"have {len(devices)}")
+    grid = np.array(devices[: n_data * n_stages]).reshape(n_data, n_stages)
+    return Mesh(grid, (DATA_AXIS, STAGE_AXIS))
+
+
+def bootstrap_distributed(rank: int, world_size: int, master_addr: str,
+                          master_port: str | int, timeout_s: int = 300) -> None:
+    """Multi-host rendezvous: the reference-compatible bootstrap.
+
+    Maps the reference CLI (``simple_distributed.py:144-165``) onto
+    ``jax.distributed.initialize``: ``--rank`` → process_id, ``--world_size`` →
+    num_processes, ``--master_addr/--master_port`` → coordinator_address.
+
+    Unlike the reference — which sets ``rpc_timeout=0`` (infinite) and hangs
+    forever on a dead peer (``simple_distributed.py:36,:167``; SURVEY §5.3) —
+    initialization here has a real timeout.
+    """
+    if world_size <= 1:
+        return  # single-process: nothing to rendezvous
+    os.environ.setdefault("JAX_COORDINATOR_TIMEOUT_SECS", str(timeout_s))
+    jax.distributed.initialize(
+        coordinator_address=f"{master_addr}:{master_port}",
+        num_processes=world_size,
+        process_id=rank,
+        initialization_timeout=timeout_s,
+    )

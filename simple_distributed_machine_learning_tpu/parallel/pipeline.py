@@ -1,0 +1,256 @@
+"""The pipeline engine: GPipe-scheduled SPMD pipeline parallelism.
+
+This is the TPU-native replacement for the reference's entire hot path — the
+blocking master→worker activation RPC (``/root/reference/simple_distributed.py:49``),
+the worker→master reply (``:80``), the distributed-autograd backward hop
+(``:109-112``), and the remote optimizer step (``:113``). All of it compiles
+into ONE ``jit``-ed SPMD program:
+
+- every device runs the same scanned loop; at step ``t`` the device holding
+  stage ``s`` computes microbatch ``t - s`` (GPipe schedule);
+- the inter-stage hop is a single ``lax.ppermute`` over the ``stage`` mesh
+  axis — on TPU this is a compiled collective-permute over ICI, overlapped by
+  XLA with the next step's compute (the reference's RPC hop is fully blocking:
+  per-step time = t(stage0) + 2·t(transfer) + t(stage1), SURVEY §3.3);
+- backward needs no distributed-autograd engine: ``jax.grad`` through
+  ``ppermute`` emits the transposed permute, so activation cotangents hop
+  stage ``s+1`` → ``s`` inside the same compiled program;
+- heterogeneous stages (conv front / fc back, as in the reference's
+  Network1/Network2 split ``:26-83``) are dispatched with ``lax.switch`` on
+  the device's stage index, over the packed stage-sharded parameter buffer
+  (see ``staging.py``).
+
+The sequential reference schedule is the ``n_microbatches=1`` special case;
+a fused single-device model is the ``n_stages=1`` special case — which is what
+makes loss-parity tests against a single-device run exact (SURVEY §7, test #1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
+from simple_distributed_machine_learning_tpu.parallel.mesh import DATA_AXIS, STAGE_AXIS
+from simple_distributed_machine_learning_tpu.parallel.staging import (
+    StageMeta,
+    pack_stage_params,
+    unpack_stage_params,
+    wire_decode,
+    wire_encode,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """One pipeline stage.
+
+    ``apply(params, x, key, deterministic) -> y`` operates on real (unpadded)
+    activations: ``x`` has per-sample shape ``in_shape``; ``y``'s trailing
+    features are re-encoded onto the wire by the engine. The last stage must
+    return log-probabilities ``[batch, out_dim]`` (the reference's stage 1
+    ends in ``log_softmax``, ``simple_distributed.py:79``).
+    """
+    apply: Callable[[Any, jax.Array, jax.Array, bool], jax.Array]
+    params: Any
+    in_shape: tuple[int, ...]
+
+
+class Pipeline:
+    """Compiled GPipe pipeline over a ``(data, stage)`` mesh.
+
+    Parameters live in a ``[n_stages, max_param_size]`` buffer sharded
+    ``P('stage')`` — each device holds only its own stage's params
+    (owner-local, like the reference's per-process modules) and updates them
+    locally inside the compiled step (replacing DistributedOptimizer,
+    ``simple_distributed.py:100-104``).
+    """
+
+    def __init__(self, stages: Sequence[Stage], mesh: jax.sharding.Mesh,
+                 wire_dim: int, out_dim: int, n_microbatches: int = 1):
+        self.stages = list(stages)
+        self.mesh = mesh
+        self.n_stages = mesh.shape[STAGE_AXIS]
+        self.n_data = mesh.shape[DATA_AXIS]
+        if len(self.stages) != self.n_stages:
+            raise ValueError(
+                f"{len(self.stages)} stages but mesh stage axis is {self.n_stages}")
+        self.wire_dim = int(wire_dim)
+        self.out_dim = int(out_dim)
+        self.n_microbatches = int(n_microbatches)
+        self._sm_cache: dict[bool, Callable] = {}
+        self._buf0, self.metas = pack_stage_params([s.params for s in self.stages])
+        self._validate_boundaries()
+
+    def _validate_boundaries(self) -> None:
+        """Shape-check every stage hop at build time (via eval_shape — no FLOPs).
+
+        The wire codec zero-pads/truncates, so a stage whose output width does
+        not match the next stage's ``in_shape`` would otherwise train silently
+        on fabricated zeros.
+        """
+        import numpy as np
+        batch = 2
+        for s, stage in enumerate(self.stages):
+            x = jax.ShapeDtypeStruct((batch,) + tuple(stage.in_shape), jnp.float32)
+            key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+            out = jax.eval_shape(
+                lambda p, xx, kk, _a=stage.apply: _a(p, xx, kk, True),
+                stage.params, x, key)
+            out_size = int(np.prod(out.shape[1:]))
+            if out_size > self.wire_dim:
+                raise ValueError(
+                    f"stage {s} output width {out_size} exceeds wire_dim "
+                    f"{self.wire_dim}")
+            if s + 1 < len(self.stages):
+                nxt = int(np.prod(self.stages[s + 1].in_shape))
+                if out_size != nxt:
+                    raise ValueError(
+                        f"stage {s} outputs {out_size} features but stage "
+                        f"{s + 1} declares in_shape={self.stages[s + 1].in_shape} "
+                        f"({nxt} features)")
+            elif out.shape[1:] != (self.out_dim,):
+                raise ValueError(
+                    f"last stage must output [batch, {self.out_dim}], got "
+                    f"{out.shape}")
+            if int(np.prod(stage.in_shape)) > self.wire_dim:
+                raise ValueError(
+                    f"stage {s} in_shape {stage.in_shape} exceeds wire_dim "
+                    f"{self.wire_dim}")
+
+    # ---- parameters -----------------------------------------------------
+
+    def init_params(self) -> jax.Array:
+        """Place the packed stage-param buffer on the mesh (stage-sharded)."""
+        sharding = NamedSharding(self.mesh, P(STAGE_AXIS, None))
+        return jax.device_put(self._buf0, sharding)
+
+    def unpack(self, buf: jax.Array) -> list[Any]:
+        """Host-side: recover the per-stage param pytrees (for tests/ckpt)."""
+        rows = jax.device_get(buf)
+        return [unpack_stage_params(jnp.asarray(rows[s]), self.metas[s])
+                for s in range(self.n_stages)]
+
+    # ---- forward/loss ---------------------------------------------------
+
+    def _shard_fn(self, deterministic: bool) -> Callable:
+        """Build (once per mode) the shard_mapped pipeline loss function."""
+        if deterministic in self._sm_cache:
+            return self._sm_cache[deterministic]
+
+        S = self.n_stages
+        M = self.n_microbatches
+        T = M + S - 1
+        wire_dim = self.wire_dim
+        out_dim = self.out_dim
+        metas = list(self.metas)
+        applies = [s.apply for s in self.stages]
+        in_shapes = [s.in_shape for s in self.stages]
+
+        def per_device(row2d, x_mb, tgt_mb, key):
+            # row2d: [1, P] local param row; x_mb: [M, mb, wire]; tgt_mb: [M, mb]
+            row = row2d[0]
+            stage = lax.axis_index(STAGE_AXIS)
+            mb = x_mb.shape[1]
+
+            def make_branch(s):
+                def branch(wire, k):
+                    params = unpack_stage_params(row, metas[s])
+                    x = wire_decode(wire, in_shapes[s])
+                    y = applies[s](params, x, k, deterministic)
+                    return wire_encode(y, wire_dim)
+                return branch
+
+            branches = [make_branch(s) for s in range(S)]
+            fwd = [(i, (i + 1) % S) for i in range(S)]
+
+            def step(carry, t):
+                wire, loss_acc, logits_acc = carry
+                # stage 0 injects a fresh microbatch every step (clipped so the
+                # drain steps recompute-and-discard the last one — finite math,
+                # zeroed below by the validity mask).
+                inj = lax.dynamic_index_in_dim(
+                    x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                wire = jnp.where(stage == 0, inj, wire)
+                # distinct dropout noise per (step, stage, data-shard)
+                k_t = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(key, t), stage),
+                    lax.axis_index(DATA_AXIS))
+                out = lax.switch(stage, branches, wire, k_t)
+                m = t - stage           # microbatch index this stage is working on
+                valid = (m >= 0) & (m < M)
+                out = jnp.where(valid, out, jnp.zeros_like(out))
+                # last stage just produced log-probs for microbatch m
+                logits = wire_decode(out, (out_dim,))
+                is_out = valid & (stage == S - 1)
+                m_safe = jnp.clip(m, 0, M - 1)
+                tgt = lax.dynamic_index_in_dim(tgt_mb, m_safe, 0, keepdims=False)
+                loss_acc = loss_acc + jnp.where(
+                    is_out, nll_loss(logits, tgt, "mean"), 0.0)
+                prev = lax.dynamic_index_in_dim(logits_acc, m_safe, 0, keepdims=False)
+                logits_acc = lax.dynamic_update_index_in_dim(
+                    logits_acc, jnp.where(is_out, logits, prev), m_safe, 0)
+                # the hop: stage s -> s+1 over ICI; autodiff transposes this
+                # into the backward s+1 -> s hop.
+                wire = lax.ppermute(out, STAGE_AXIS, fwd)
+                return (wire, loss_acc, logits_acc), None
+
+            init = (jnp.zeros((mb, wire_dim), x_mb.dtype),
+                    jnp.float32(0.0),
+                    jnp.zeros((M, mb, out_dim), jnp.float32))
+            (_, loss_sum, logits_acc), _ = lax.scan(step, init, jnp.arange(T))
+
+            loss = lax.psum(loss_sum, STAGE_AXIS) / M     # only last stage added
+            loss = lax.pmean(loss, DATA_AXIS)             # data-parallel mean
+            logits = lax.psum(logits_acc, STAGE_AXIS)     # replicate last stage's
+            return loss, logits
+
+        fn = jax.shard_map(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(P(STAGE_AXIS, None), P(None, DATA_AXIS, None),
+                      P(None, DATA_AXIS), P()),
+            out_specs=(P(), P(None, DATA_AXIS, None)),
+            check_vma=False,
+        )
+        self._sm_cache[deterministic] = fn
+        return fn
+
+    def loss_and_logits(self, buf: jax.Array, x: jax.Array, targets: jax.Array,
+                        key: jax.Array, deterministic: bool = False
+                        ) -> tuple[jax.Array, jax.Array]:
+        """Mean NLL loss + per-example log-probs for a global batch.
+
+        ``x``: [B, ...] model input (stage 0's real input shape);
+        ``targets``: [B] int labels. B must divide by
+        ``n_microbatches * n_data``.
+        """
+        M = self.n_microbatches
+        B = x.shape[0]
+        if B % (M * self.n_data) != 0:
+            raise ValueError(
+                f"batch {B} not divisible by microbatches*data = {M * self.n_data}")
+        xw = wire_encode(x, self.wire_dim).reshape(M, B // M, self.wire_dim)
+        tgt = targets.reshape(M, B // M)
+        loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, key)
+        return loss, logits.reshape(B, self.out_dim)
+
+
+def fused_reference(stages: Sequence[Stage]) -> Callable:
+    """Single-device composition of the stages (ground truth for parity tests:
+    the pipeline on N devices must match this to float tolerance, SURVEY §7)."""
+    def apply(stage_params: Sequence[Any], x: jax.Array, key: jax.Array,
+              deterministic: bool = False) -> jax.Array:
+        h = x
+        for s, (stage, params) in enumerate(zip(stages, stage_params)):
+            k = jax.random.fold_in(key, s)
+            h = h.reshape((h.shape[0],) + stage.in_shape)
+            h = stage.apply(params, h, k, deterministic)
+        return h
+    return apply

@@ -1,0 +1,9 @@
+"""Training: optimizers, the compiled train step, driver loops, checkpointing."""
+
+from simple_distributed_machine_learning_tpu.train.optimizer import (  # noqa: F401
+    sgd,
+)
+from simple_distributed_machine_learning_tpu.train.step import (  # noqa: F401
+    make_eval_step,
+    make_train_step,
+)

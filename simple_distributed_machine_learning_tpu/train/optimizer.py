@@ -1,0 +1,58 @@
+"""Optimizers with owner-local sharded state.
+
+Replaces the reference's DistributedOptimizer
+(``/root/reference/simple_distributed.py:100-104,:113``), which RPCs into each
+param-owning process to run a local ``optim.SGD`` step. In SPMD, "owner-local"
+is free: optimizer state is created with the same sharding as the parameter
+buffer (``P('stage')``), so each device updates exactly its own stage's params
+and momentum inside the compiled train step — no RPC, no separate engine.
+
+``sgd`` reproduces torch's SGD-with-momentum update rule
+(``buf = momentum * buf + grad; p -= lr * buf``) for loss-curve parity with
+the reference's hyperparameters (lr=0.1, momentum=0.5,
+``simple_distributed.py:20-21,:103``). Any optax transform can be used
+instead via :func:`from_optax`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], tuple[Any, Any]]  # (grads, state, params) -> (new_params, new_state)
+
+
+def sgd(learning_rate: float, momentum: float = 0.0) -> Optimizer:
+    """torch-semantics SGD(momentum). State = momentum buffer (like-sharded)."""
+
+    def init(params):
+        if momentum == 0.0:
+            return ()
+        return jax.tree.map(jax.numpy.zeros_like, params)
+
+    def update(grads, state, params):
+        if momentum == 0.0:
+            new_params = jax.tree.map(lambda p, g: p - learning_rate * g,
+                                      params, grads)
+            return new_params, ()
+        new_buf = jax.tree.map(lambda b, g: momentum * b + g, state, grads)
+        new_params = jax.tree.map(lambda p, b: p - learning_rate * b,
+                                  params, new_buf)
+        return new_params, new_buf
+
+    return Optimizer(init, update)
+
+
+def from_optax(tx) -> Optimizer:
+    """Adapt an optax GradientTransformation to this interface."""
+    import optax
+
+    def update(grads, state, params):
+        updates, new_state = tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    return Optimizer(tx.init, update)

@@ -1,0 +1,59 @@
+"""The compiled train/eval steps.
+
+One ``jit`` covers what the reference spreads over four distributed subsystems
+per batch — forward RPC, loss, distributed-autograd backward, remote optimizer
+step (``/root/reference/simple_distributed.py:109-113``). Buffers are donated,
+so params and optimizer state update in place on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+from simple_distributed_machine_learning_tpu.train.optimizer import Optimizer
+
+
+def make_train_step(pipe: Pipeline, opt: Optimizer):
+    """Returns ``step(buf, opt_state, x, targets, key) -> (buf, opt_state, loss)``.
+
+    The whole pipeline fwd + bwd + update is one XLA program: the forward
+    ppermute hops, their autodiff transposes (the backward hops), and each
+    stage's owner-local optimizer update all schedule together, letting XLA
+    overlap ICI transfer with compute — the overlap the reference's blocking
+    RPC design structurally cannot have (SURVEY §3.3).
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(buf, opt_state, x, targets, key):
+        def loss_fn(b):
+            loss, _ = pipe.loss_and_logits(b, x, targets, key, deterministic=False)
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(buf)
+        buf2, opt_state2 = opt.update(grads, opt_state, buf)
+        return buf2, opt_state2, loss
+
+    return step
+
+
+def make_eval_step(pipe: Pipeline):
+    """Returns ``eval_step(buf, x, targets, key) -> (sum_nll, n_correct)``.
+
+    Deterministic: dropout is OFF — deliberately diverging from the
+    reference's quirk of leaving worker-side dropout active during eval
+    (``simple_distributed.py:75`` with ``model.eval()`` not crossing RPC at
+    ``:120``; SURVEY §3.5 flags this as a bug not to carry over).
+    """
+
+    @jax.jit
+    def step(buf, x, targets, key):
+        _, logp = pipe.loss_and_logits(buf, x, targets, key, deterministic=True)
+        from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
+        sum_loss = nll_loss(logp, targets, reduction="sum")
+        correct = (logp.argmax(-1) == targets).sum()
+        return sum_loss, correct
+
+    return step

@@ -1,0 +1,100 @@
+"""Numerical parity of the functional ops against torch (CPU).
+
+The reference's layer math is torch's (``/root/reference/simple_distributed.py:42-46,
+:75-79``); these tests pin our NHWC/JAX implementations to the same numerics
+so loss-curve parity is meaningful.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+from simple_distributed_machine_learning_tpu import ops
+
+RTOL = 1e-5
+ATOL = 1e-5
+
+
+def test_linear_matches_torch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 7)).astype(np.float32)
+    w = rng.normal(size=(7, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    got = ops.linear({"w": jnp.asarray(w), "b": jnp.asarray(b)}, jnp.asarray(x))
+    want = TF.linear(torch.from_numpy(x), torch.from_numpy(w.T),
+                     torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_matches_torch():
+    rng = np.random.default_rng(1)
+    x_nchw = rng.normal(size=(2, 3, 10, 10)).astype(np.float32)
+    w_oihw = rng.normal(size=(5, 3, 4, 4)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    # ours: NHWC activations, HWIO weights
+    x_nhwc = jnp.asarray(x_nchw.transpose(0, 2, 3, 1))
+    w_hwio = jnp.asarray(w_oihw.transpose(2, 3, 1, 0))
+    got = ops.conv2d({"w": w_hwio, "b": jnp.asarray(b)}, x_nhwc)
+    want = TF.conv2d(torch.from_numpy(x_nchw), torch.from_numpy(w_oihw),
+                     torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(np.asarray(got).transpose(0, 3, 1, 2), want,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_max_pool2d_matches_torch():
+    rng = np.random.default_rng(2)
+    x_nchw = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    got = ops.max_pool2d(jnp.asarray(x_nchw.transpose(0, 2, 3, 1)), 2)
+    want = TF.max_pool2d(torch.from_numpy(x_nchw), 2).numpy()
+    np.testing.assert_allclose(np.asarray(got).transpose(0, 3, 1, 2), want,
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_log_softmax_nll_match_torch():
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(6, 10)).astype(np.float32) * 3
+    targets = rng.integers(0, 10, size=(6,))
+    lp = ops.log_softmax(jnp.asarray(logits))
+    np.testing.assert_allclose(
+        np.asarray(lp), TF.log_softmax(torch.from_numpy(logits), dim=1).numpy(),
+        rtol=RTOL, atol=ATOL)
+    for reduction in ("mean", "sum"):
+        got = ops.nll_loss(lp, jnp.asarray(targets), reduction)
+        want = TF.nll_loss(TF.log_softmax(torch.from_numpy(logits), dim=1),
+                           torch.from_numpy(targets).long(),
+                           reduction=reduction).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=RTOL, atol=ATOL)
+
+
+def test_linear_init_matches_torch_bounds():
+    params = ops.linear_init(jax.random.key(0), 320, 50)
+    bound = 1.0 / np.sqrt(320)
+    w = np.asarray(params["w"])
+    assert w.shape == (320, 50)
+    assert w.min() >= -bound and w.max() <= bound
+    # torch draws from the same bound
+    tl = torch.nn.Linear(320, 50)
+    assert abs(tl.weight.detach().numpy().max()) <= bound + 1e-6
+
+
+def test_dropout_semantics():
+    key = jax.random.key(0)
+    x = jnp.ones((100, 100))
+    y = ops.dropout(key, x, rate=0.5)
+    kept = np.asarray(y != 0)
+    assert 0.4 < kept.mean() < 0.6
+    np.testing.assert_allclose(np.asarray(y)[kept], 2.0)  # inverted scaling
+    np.testing.assert_allclose(
+        np.asarray(ops.dropout(key, x, 0.5, deterministic=True)), np.asarray(x))
+
+
+def test_dropout2d_drops_whole_channels():
+    key = jax.random.key(1)
+    x = jnp.ones((8, 4, 4, 16))
+    y = np.asarray(ops.dropout2d(key, x, rate=0.5))
+    # each (sample, channel) plane is uniformly zero or uniformly scaled
+    per_plane = y.transpose(0, 3, 1, 2).reshape(8 * 16, -1)
+    assert np.all((per_plane == 0).all(-1) | (per_plane == 2.0).all(-1))

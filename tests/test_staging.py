@@ -1,0 +1,34 @@
+"""Stage packing and wire codec round-trips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simple_distributed_machine_learning_tpu.parallel.staging import (
+    pack_stage_params,
+    unpack_stage_params,
+    wire_decode,
+    wire_encode,
+)
+
+
+def test_pack_unpack_roundtrip_heterogeneous():
+    p0 = {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.ones((4,))}
+    p1 = [{"w": jnp.full((2, 2), 2.0)}, {"b": jnp.zeros((5,))}]
+    buf, metas = pack_stage_params([p0, p1])
+    assert buf.shape == (2, 16)  # max(12+4, 4+5) = 16
+    r0 = unpack_stage_params(buf[0], metas[0])
+    r1 = unpack_stage_params(buf[1], metas[1])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                         np.asarray(b)), p0, r0)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                         np.asarray(b)), p1, r1)
+
+
+def test_wire_roundtrip():
+    x = jnp.arange(24.0).reshape(2, 3, 4)  # batch 2, per-sample (3, 4)
+    wire = wire_encode(x, 20)
+    assert wire.shape == (2, 20)
+    back = wire_decode(wire, (3, 4))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(wire[:, 12:]), 0.0)
