@@ -1,0 +1,155 @@
+"""Headline benchmark: samples/sec/chip on the 2-stage MLP pipeline.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Config (BASELINE.json configs 1-2): 2-layer MLP 784-512-10 (stage0=fc1,
+stage1=fc2), batch 60 (the reference's batch size, simple_distributed.py:18),
+SGD(lr=0.1, momentum=0.5), random tensors. The measured run uses the
+epoch-compiled train step (lax.scan over batches) — one dispatch per window,
+so the number reflects chip throughput, not host/tunnel dispatch latency.
+
+``vs_baseline`` divides by the stored CPU baseline (benchmarks/
+baseline_cpu.json): the torch.distributed.rpc 2-process CPU implementation of
+the same workload (the reference's architecture, measured by
+benchmarks/torch_rpc_baseline.py) — i.e. "ours on TPU vs theirs on CPU",
+which is the north-star comparison. Regenerate baselines with
+``python bench.py --measure-baseline``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_PATH = os.path.join(REPO, "benchmarks", "baseline_cpu.json")
+
+DIMS = [784, 512, 10]
+BATCH = 60
+N_MICRO = 1          # reference schedule: one microbatch
+SCAN_STEPS = 100
+WINDOWS = 5
+
+
+def measure_pipeline_sps(scan_steps: int = SCAN_STEPS,
+                         windows: int = WINDOWS) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+    from simple_distributed_machine_learning_tpu.train.optimizer import sgd
+    from simple_distributed_machine_learning_tpu.train.step import (
+        make_scanned_train_step,
+    )
+
+    n_dev = len(jax.devices())
+    n_stages = 2 if n_dev >= 2 else 1
+    mesh = make_mesh(n_stages=n_stages, n_data=1)
+
+    key = jax.random.key(0)
+    stages, wire_dim, out_dim = make_mlp_stages(key, DIMS, n_stages)
+    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=N_MICRO)
+    buf = pipe.init_params()
+    opt = sgd(0.1, momentum=0.5)
+    opt_state = opt.init(buf)
+    step = make_scanned_train_step(pipe, opt)
+
+    xs = jax.random.normal(key, (scan_steps, BATCH, DIMS[0]))
+    ts = jax.random.randint(key, (scan_steps, BATCH), 0, DIMS[-1])
+
+    # warmup (compile)
+    buf, opt_state, losses = step(buf, opt_state, xs, ts, key)
+    jax.block_until_ready(losses)
+
+    best = 0.0
+    for w in range(windows):
+        t0 = time.perf_counter()
+        buf, opt_state, losses = step(buf, opt_state, xs, ts,
+                                      jax.random.fold_in(key, w))
+        jax.block_until_ready(losses)
+        dt = time.perf_counter() - t0
+        best = max(best, scan_steps * BATCH / dt)
+
+    n_chips = n_stages  # chips participating in the pipeline
+    return {
+        "samples_per_sec": best,
+        "samples_per_sec_per_chip": best / n_chips,
+        "n_chips": n_chips,
+        "backend": jax.default_backend(),
+        "final_loss": float(losses[-1]),
+    }
+
+
+def _measure_jax_cpu_baseline() -> float:
+    """Our own pipeline on 2 virtual CPU devices (BASELINE config 1 analog)."""
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "jax.config.update('jax_num_cpu_devices',2);"
+        "import sys; sys.path.insert(0, %r);"
+        "from bench import measure_pipeline_sps;"
+        "import json; print('RESULT'+json.dumps(measure_pipeline_sps()))"
+        % REPO)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=600, cwd=REPO)
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT"):
+            return json.loads(line[len("RESULT"):])["samples_per_sec"]
+    raise RuntimeError(f"jax cpu baseline failed: {out.stderr[-2000:]}")
+
+
+def _measure_torch_rpc_baseline() -> float:
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "torch_rpc_baseline.py")],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT"):
+            return json.loads(line[len("RESULT"):])["samples_per_sec"]
+    raise RuntimeError(f"torch rpc baseline failed: {out.stderr[-2000:]}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--measure-baseline", action="store_true",
+                    help="re-measure CPU baselines and rewrite "
+                         "benchmarks/baseline_cpu.json")
+    ap.add_argument("--steps", type=int, default=SCAN_STEPS)
+    args = ap.parse_args()
+
+    if args.measure_baseline or not os.path.exists(BASELINE_PATH):
+        baselines = {}
+        try:
+            baselines["torch_rpc_cpu_samples_per_sec"] = \
+                _measure_torch_rpc_baseline()
+        except Exception as e:  # noqa: BLE001 - record and continue
+            baselines["torch_rpc_cpu_error"] = str(e)[-500:]
+        baselines["jax_cpu_pipeline_samples_per_sec"] = \
+            _measure_jax_cpu_baseline()
+        os.makedirs(os.path.dirname(BASELINE_PATH), exist_ok=True)
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baselines, f, indent=2)
+    else:
+        with open(BASELINE_PATH) as f:
+            baselines = json.load(f)
+
+    res = measure_pipeline_sps(scan_steps=args.steps)
+    base = baselines.get("torch_rpc_cpu_samples_per_sec") or \
+        baselines.get("jax_cpu_pipeline_samples_per_sec")
+    print(json.dumps({
+        "metric": "2stage_mlp_pipeline_samples_per_sec_per_chip",
+        "value": round(res["samples_per_sec_per_chip"], 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(res["samples_per_sec"] / base, 2) if base else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""CPU baseline: the reference's architecture, measured.
+
+A fresh implementation (not a copy) of the reference's design — two processes,
+pipeline-split model, torch.distributed.rpc transport, distributed autograd,
+DistributedOptimizer (see SURVEY.md §0/§3 for the architecture being
+reproduced) — on BASELINE.json config 1: a 2-layer MLP split rank0=fc1 /
+rank1=fc2, random tensors, batch 60, SGD(lr=0.1, momentum=0.5).
+
+Run directly: prints ``RESULT{json}`` with steady-state samples/sec. This is
+the number the TPU build's ``bench.py`` divides by for ``vs_baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import torch
+import torch.distributed.autograd as dist_autograd
+import torch.distributed.rpc as rpc
+import torch.multiprocessing as mp
+import torch.nn as nn
+from torch.distributed.optim import DistributedOptimizer
+from torch.distributed.rpc import RRef
+
+DIMS = (784, 512, 10)
+BATCH = 60
+WARMUP = 20
+STEPS = 100
+
+
+class BackHalf(nn.Module):
+    """fc2 + log_softmax, hosted on the worker process."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc2 = nn.Linear(DIMS[1], DIMS[2])
+
+    def forward(self, x_rref: RRef) -> torch.Tensor:
+        x = x_rref.to_here()
+        return torch.log_softmax(self.fc2(x), dim=1)
+
+    def param_rrefs(self):
+        return [RRef(p) for p in self.parameters()]
+
+
+class FrontHalf(nn.Module):
+    """fc1 on the master; holds the remote handle to the back half."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(DIMS[0], DIMS[1])
+        self.back = rpc.remote("worker", BackHalf)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        h = torch.relu(self.fc1(x))
+        return self.back.rpc_sync().forward(RRef(h))
+
+    def all_param_rrefs(self):
+        local = [RRef(p) for p in self.parameters()]
+        return local + self.back.rpc_sync().param_rrefs()
+
+
+def run_master() -> None:
+    torch.manual_seed(0)
+    model = FrontHalf()
+    opt = DistributedOptimizer(
+        torch.optim.SGD, model.all_param_rrefs(), lr=0.1, momentum=0.5)
+    x = torch.randn(BATCH, DIMS[0])
+    y = torch.randint(0, DIMS[2], (BATCH,))
+
+    def one_step() -> None:
+        with dist_autograd.context() as ctx:
+            out = model(x)
+            loss = torch.nn.functional.nll_loss(out, y)
+            dist_autograd.backward(ctx, [loss])
+            opt.step(ctx)
+
+    for _ in range(WARMUP):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        one_step()
+    dt = time.perf_counter() - t0
+    print("RESULT" + json.dumps({
+        "samples_per_sec": STEPS * BATCH / dt,
+        "steps_per_sec": STEPS / dt,
+        "impl": "torch_rpc_2proc_cpu",
+    }), flush=True)
+
+
+def _proc(rank: int) -> None:
+    os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
+    os.environ.setdefault("MASTER_PORT", "29611")
+    opts = rpc.TensorPipeRpcBackendOptions(num_worker_threads=16,
+                                           rpc_timeout=120)
+    name = "master" if rank == 0 else "worker"
+    rpc.init_rpc(name, rank=rank, world_size=2, rpc_backend_options=opts)
+    if rank == 0:
+        run_master()
+    rpc.shutdown()
+
+
+def main() -> None:
+    mp.start_processes(_proc, nprocs=2, start_method="spawn")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""LeNet with the reference's exact conv↔fc pipeline split.
+
+Stage 0 is the reference's ``Network1`` spec — conv(1→10,k5) → maxpool2 → relu;
+conv(10→20,k5) → dropout2d → maxpool2 → relu → flatten-to-320
+(``/root/reference/simple_distributed.py:42-46``). Stage 1 is ``Network2`` —
+fc(320→50) → relu → dropout → fc(50→10) → log_softmax (``:75-79``).
+
+Differences by design (not oversights):
+- activations are NHWC (TPU MXU layout), so the 320-feature flatten interleaves
+  (H, W, C) rather than torch's (C, H, W) — a fixed permutation of the same
+  features, irrelevant to learning dynamics;
+- dropout takes explicit keys and honours ``deterministic`` — the reference's
+  eval keeps worker-side dropout active (``:75`` vs ``:120``; SURVEY §3.5 rules
+  this a quirk not to carry over).
+
+``n_stages=1`` returns the fused single-device LeNet (for parity baselines);
+``n_stages=2`` is the reference topology (BASELINE.json config 4).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from simple_distributed_machine_learning_tpu.ops.layers import (
+    conv2d,
+    conv2d_init,
+    dropout,
+    dropout2d,
+    linear,
+    linear_init,
+    max_pool2d,
+    relu,
+)
+from simple_distributed_machine_learning_tpu.ops.losses import log_softmax
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Stage
+
+IN_SHAPE = (28, 28, 1)   # NHWC per-sample
+FEATURES = 320           # 20 channels * 4 * 4 after two conv/pool blocks
+N_CLASSES = 10
+
+
+def _conv_apply(params, x, key, deterministic):
+    h = relu(max_pool2d(conv2d(params["conv1"], x), 2))
+    h = conv2d(params["conv2"], h)
+    h = dropout2d(key, h, rate=0.5, deterministic=deterministic)
+    h = relu(max_pool2d(h, 2))
+    return h.reshape(h.shape[0], FEATURES)
+
+
+def _fc_apply(params, x, key, deterministic):
+    h = relu(linear(params["fc1"], x))
+    h = dropout(key, h, rate=0.5, deterministic=deterministic)
+    h = linear(params["fc2"], h)
+    return log_softmax(h)
+
+
+def make_lenet_stages(key: jax.Array, n_stages: int = 2
+                      ) -> tuple[list[Stage], int, int]:
+    """Build LeNet as pipeline stages. Returns ``(stages, wire_dim, out_dim)``."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    conv_params = {"conv1": conv2d_init(k1, 1, 10, 5),
+                   "conv2": conv2d_init(k2, 10, 20, 5)}
+    fc_params = {"fc1": linear_init(k3, FEATURES, 50),
+                 "fc2": linear_init(k4, 50, N_CLASSES)}
+    wire_dim = max(28 * 28 * 1, FEATURES, N_CLASSES)  # input image is widest
+
+    if n_stages == 2:
+        stages = [
+            Stage(apply=_conv_apply, params=conv_params, in_shape=IN_SHAPE),
+            Stage(apply=_fc_apply, params=fc_params, in_shape=(FEATURES,)),
+        ]
+    elif n_stages == 1:
+        def fused(params, x, key, deterministic):
+            kc, kf = jax.random.split(key)
+            h = _conv_apply(params["conv"], x, kc, deterministic)
+            return _fc_apply(params["fc"], h, kf, deterministic)
+        stages = [Stage(apply=fused,
+                        params={"conv": conv_params, "fc": fc_params},
+                        in_shape=IN_SHAPE)]
+    else:
+        raise ValueError(f"LeNet supports 1 or 2 stages, got {n_stages}")
+    return stages, wire_dim, N_CLASSES

@@ -153,8 +153,9 @@ class Pipeline:
         applies = [s.apply for s in self.stages]
         in_shapes = [s.in_shape for s in self.stages]
 
-        def per_device(row2d, x_mb, tgt_mb, key):
-            # row2d: [1, P] local param row; x_mb: [M, mb, wire]; tgt_mb: [M, mb]
+        def per_device(row2d, x_mb, tgt_mb, w_mb, key):
+            # row2d: [1, P] local param row; x_mb: [M, mb, wire];
+            # tgt_mb/w_mb: [M, mb] targets and per-sample loss weights
             row = row2d[0]
             stage = lax.axis_index(STAGE_AXIS)
             mb = x_mb.shape[1]
@@ -171,7 +172,7 @@ class Pipeline:
             fwd = [(i, (i + 1) % S) for i in range(S)]
 
             def step(carry, t):
-                wire, loss_acc, logits_acc = carry
+                wire, num_acc, den_acc, logits_acc = carry
                 # stage 0 injects a fresh microbatch every step (clipped so the
                 # drain steps recompute-and-discard the last one — finite math,
                 # zeroed below by the validity mask).
@@ -191,23 +192,28 @@ class Pipeline:
                 is_out = valid & (stage == S - 1)
                 m_safe = jnp.clip(m, 0, M - 1)
                 tgt = lax.dynamic_index_in_dim(tgt_mb, m_safe, 0, keepdims=False)
-                loss_acc = loss_acc + jnp.where(
-                    is_out, nll_loss(logits, tgt, "mean"), 0.0)
+                w = lax.dynamic_index_in_dim(w_mb, m_safe, 0, keepdims=False)
+                per_ex = nll_loss(logits, tgt, "none") * w
+                num_acc = num_acc + jnp.where(is_out, jnp.sum(per_ex), 0.0)
+                den_acc = den_acc + jnp.where(is_out, jnp.sum(w), 0.0)
                 prev = lax.dynamic_index_in_dim(logits_acc, m_safe, 0, keepdims=False)
                 logits_acc = lax.dynamic_update_index_in_dim(
                     logits_acc, jnp.where(is_out, logits, prev), m_safe, 0)
                 # the hop: stage s -> s+1 over ICI; autodiff transposes this
                 # into the backward s+1 -> s hop.
                 wire = lax.ppermute(out, STAGE_AXIS, fwd)
-                return (wire, loss_acc, logits_acc), None
+                return (wire, num_acc, den_acc, logits_acc), None
 
             init = (jnp.zeros((mb, wire_dim), x_mb.dtype),
-                    jnp.float32(0.0),
+                    jnp.float32(0.0), jnp.float32(0.0),
                     jnp.zeros((M, mb, out_dim), jnp.float32))
-            (_, loss_sum, logits_acc), _ = lax.scan(step, init, jnp.arange(T))
+            (_, num, den, logits_acc), _ = lax.scan(step, init, jnp.arange(T))
 
-            loss = lax.psum(loss_sum, STAGE_AXIS) / M     # only last stage added
-            loss = lax.pmean(loss, DATA_AXIS)             # data-parallel mean
+            # weighted global mean: sum(w * nll) / sum(w), reduced over the
+            # stage axis (only the last stage contributed) and the data axis.
+            num = lax.psum(lax.psum(num, STAGE_AXIS), DATA_AXIS)
+            den = lax.psum(lax.psum(den, STAGE_AXIS), DATA_AXIS)
+            loss = num / jnp.maximum(den, 1e-12)
             logits = lax.psum(logits_acc, STAGE_AXIS)     # replicate last stage's
             return loss, logits
 
@@ -215,7 +221,7 @@ class Pipeline:
             per_device,
             mesh=self.mesh,
             in_specs=(P(STAGE_AXIS, None), P(None, DATA_AXIS, None),
-                      P(None, DATA_AXIS), P()),
+                      P(None, DATA_AXIS), P(None, DATA_AXIS), P()),
             out_specs=(P(), P(None, DATA_AXIS, None)),
             check_vma=False,
         )
@@ -223,14 +229,19 @@ class Pipeline:
         return fn
 
     def loss_and_logits(self, buf: jax.Array, x: jax.Array, targets: jax.Array,
-                        key: jax.Array, deterministic: bool = False
+                        key: jax.Array, deterministic: bool = False,
+                        weights: jax.Array | None = None
                         ) -> tuple[jax.Array, jax.Array]:
-        """Mean NLL loss + per-example log-probs for a global batch.
+        """Weighted-mean NLL loss + per-example log-probs for a global batch.
 
         ``x``: [B, ...] model input (stage 0's real input shape);
-        ``targets``: [B] int labels. B must divide by
-        ``n_microbatches * n_data``.
+        ``targets``: [B] int labels; ``weights``: optional [B] per-sample loss
+        weights (e.g. a 0/1 validity mask for a zero-padded ragged batch —
+        loss = sum(w·nll)/sum(w), so padding does not dilute the mean). B must
+        divide by ``n_microbatches * n_data``.
         """
+        import jax.numpy as jnp
+
         M = self.n_microbatches
         B = x.shape[0]
         if B % (M * self.n_data) != 0:
@@ -238,7 +249,9 @@ class Pipeline:
                 f"batch {B} not divisible by microbatches*data = {M * self.n_data}")
         xw = wire_encode(x, self.wire_dim).reshape(M, B // M, self.wire_dim)
         tgt = targets.reshape(M, B // M)
-        loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, key)
+        w = (jnp.ones((B,), jnp.float32) if weights is None
+             else weights.astype(jnp.float32)).reshape(M, B // M)
+        loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, w, key)
         return loss, logits.reshape(B, self.out_dim)
 
 

@@ -5,5 +5,10 @@ from simple_distributed_machine_learning_tpu.train.optimizer import (  # noqa: F
 )
 from simple_distributed_machine_learning_tpu.train.step import (  # noqa: F401
     make_eval_step,
+    make_scanned_train_step,
     make_train_step,
+)
+from simple_distributed_machine_learning_tpu.train.trainer import (  # noqa: F401
+    TrainConfig,
+    Trainer,
 )

@@ -28,9 +28,10 @@ def make_train_step(pipe: Pipeline, opt: Optimizer):
     """
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(buf, opt_state, x, targets, key):
+    def step(buf, opt_state, x, targets, key, weights=None):
         def loss_fn(b):
-            loss, _ = pipe.loss_and_logits(b, x, targets, key, deterministic=False)
+            loss, _ = pipe.loss_and_logits(b, x, targets, key,
+                                           deterministic=False, weights=weights)
             return loss
         loss, grads = jax.value_and_grad(loss_fn)(buf)
         buf2, opt_state2 = opt.update(grads, opt_state, buf)
@@ -39,21 +40,62 @@ def make_train_step(pipe: Pipeline, opt: Optimizer):
     return step
 
 
+def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
+    """Returns ``step(buf, opt_state, xs, targets, key) -> (buf, opt_state, losses)``
+    where ``xs``/``targets`` carry a leading ``n_steps`` axis: one compiled
+    program runs ``n_steps`` optimizer steps via ``lax.scan``.
+
+    Why this exists: the reference dispatches every batch from Python through
+    a blocking RPC (``simple_distributed.py:108-113``), so host overhead is
+    paid per batch. On TPU the same Python-side loop would pay ~ms-scale
+    dispatch per step, dwarfing the sub-ms compute of reference-scale models.
+    Scanning the whole window keeps the chip busy back-to-back — this is the
+    TPU-idiomatic shape of a training loop, and what ``bench.py`` measures.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(buf, opt_state, xs, targets, key):
+        def body(carry, batch):
+            b, s, i = carry
+            x, t = batch
+            k = jax.random.fold_in(key, i)
+
+            def loss_fn(bb):
+                loss, _ = pipe.loss_and_logits(bb, x, t, k, deterministic=False)
+                return loss
+            loss, grads = jax.value_and_grad(loss_fn)(b)
+            b2, s2 = opt.update(grads, s, b)
+            return (b2, s2, i + 1), loss
+
+        (buf2, opt2, _), losses = jax.lax.scan(
+            body, (buf, opt_state, 0), (xs, targets), unroll=unroll)
+        return buf2, opt2, losses
+
+    return step
+
+
 def make_eval_step(pipe: Pipeline):
-    """Returns ``eval_step(buf, x, targets, key) -> (sum_nll, n_correct)``.
+    """Returns ``eval_step(buf, x, targets, key, n_valid) -> (sum_nll, n_correct)``.
 
     Deterministic: dropout is OFF — deliberately diverging from the
     reference's quirk of leaving worker-side dropout active during eval
     (``simple_distributed.py:75`` with ``model.eval()`` not crossing RPC at
     ``:120``; SURVEY §3.5 flags this as a bug not to carry over).
+
+    ``n_valid`` masks zero-padded trailing rows of a ragged final batch (the
+    compiled pipeline needs static shapes; the reference's DataLoader just
+    emits a short batch, ``simple_distributed.py:95``).
     """
+    import jax.numpy as jnp
+
+    from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
 
     @jax.jit
-    def step(buf, x, targets, key):
+    def step(buf, x, targets, key, n_valid):
         _, logp = pipe.loss_and_logits(buf, x, targets, key, deterministic=True)
-        from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
-        sum_loss = nll_loss(logp, targets, reduction="sum")
-        correct = (logp.argmax(-1) == targets).sum()
+        mask = (jnp.arange(x.shape[0]) < n_valid).astype(jnp.float32)
+        sum_loss = jnp.sum(nll_loss(logp, targets, reduction="none") * mask)
+        correct = jnp.sum((logp.argmax(-1) == targets) * mask.astype(jnp.int32))
         return sum_loss, correct
 
     return step

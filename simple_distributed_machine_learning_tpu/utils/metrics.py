@@ -1,0 +1,36 @@
+"""Step timing and throughput metering.
+
+The reference never measures time or throughput (SURVEY §6 — its only output
+is loss/accuracy prints); the driver's north-star metric is samples/sec/chip,
+so the framework meters it natively.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Throughput:
+    """Tracks samples/sec over a window of steps (host-side wall clock)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._samples = 0
+        self._steps = 0
+
+    def update(self, n_samples: int) -> None:
+        self._samples += n_samples
+        self._steps += 1
+
+    @property
+    def samples_per_sec(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._samples / dt if dt > 0 else 0.0
+
+    @property
+    def steps_per_sec(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._steps / dt if dt > 0 else 0.0

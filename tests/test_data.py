@@ -1,0 +1,45 @@
+"""Data pipeline: reference sizing/ordering semantics + hermetic fallback."""
+
+import numpy as np
+
+from simple_distributed_machine_learning_tpu.data.mnist import (
+    batches,
+    load_mnist,
+    synthetic_mnist,
+)
+
+
+def test_reference_sizing_and_determinism():
+    # Reference: both splits cut to 1/10 -> 6000 train / 1000 test
+    # (simple_distributed.py:91-92); deterministic order (:94-95).
+    train, test = load_mnist(root="/nonexistent-data-dir")
+    assert train.x.shape == (6000, 28, 28, 1) and train.y.shape == (6000,)
+    assert test.x.shape == (1000, 28, 28, 1)
+    assert train.x.dtype == np.float32 and 0.0 <= train.x.min() <= train.x.max() <= 1.0
+    train2, _ = load_mnist(root="/nonexistent-data-dir")
+    np.testing.assert_array_equal(train.x, train2.x)
+    np.testing.assert_array_equal(train.y, train2.y)
+
+
+def test_synthetic_is_learnable_structure():
+    train, _ = synthetic_mnist(n_train=200, n_test=10)
+    # class-conditional means must differ (else nothing to learn)
+    m0 = train.x[train.y == 0].mean(0)
+    m1 = train.x[train.y == 1].mean(0)
+    assert np.abs(m0 - m1).mean() > 0.05
+
+
+def test_batches_fixed_order_and_ragged_padding():
+    train, test = load_mnist(root="/nonexistent-data-dir")
+    bs = list(batches(test, 60, pad_last=True))
+    # reference test split: 1000 = 16*60 + 40
+    assert len(bs) == 17
+    assert all(b.x.shape == (60, 28, 28, 1) for b in bs)
+    assert bs[-1].n_valid == 40
+    np.testing.assert_array_equal(bs[-1].x[40:], 0.0)
+    # fixed order: first batch is the first 60 rows
+    np.testing.assert_array_equal(bs[0].x, test.x[:60])
+
+    # train split divides exactly; pad_last=False drops nothing
+    tb = list(batches(train, 60, pad_last=False))
+    assert len(tb) == 100 and all(b.n_valid == 60 for b in tb)

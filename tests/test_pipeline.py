@@ -139,6 +139,28 @@ def test_data_parallel_matches_single_data_rank():
                                rtol=5e-5, atol=5e-5)
 
 
+def test_weighted_loss_masks_padding():
+    """Zero-weighted padded rows must not dilute the loss: weighted loss over
+    a padded batch == unweighted loss over just the valid prefix."""
+    key = jax.random.key(13)
+    stages, wire_dim, out_dim, x, targets = _make_problem(key, [12, 16, 10], 2, 16)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=2)
+    buf = pipe.init_params()
+
+    n_valid = 10
+    x_pad = x.at[n_valid:].set(0.0)
+    w = (jnp.arange(16) < n_valid).astype(jnp.float32)
+    loss_w = pipe.loss_and_logits(buf, x_pad, targets, key, True, weights=w)[0]
+
+    # unweighted over the valid prefix (use a divisible sub-batch)
+    pipe1 = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=1)
+    loss_ref = pipe1.loss_and_logits(buf, x[:n_valid], targets[:n_valid],
+                                     key, True)[0]
+    np.testing.assert_allclose(float(loss_w), float(loss_ref),
+                               rtol=RTOL, atol=RTOL)
+
+
 def test_dropout_trains_and_eval_is_deterministic():
     key = jax.random.key(11)
     stages, wire_dim, out_dim, x, targets = _make_problem(key, [12, 16, 10], 2, 8)

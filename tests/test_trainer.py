@@ -1,0 +1,61 @@
+"""Trainer console parity and end-to-end learning on the synthetic dataset."""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.data.mnist import Dataset, synthetic_mnist
+from simple_distributed_machine_learning_tpu.models.lenet import make_lenet_stages
+from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages
+from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+from simple_distributed_machine_learning_tpu.train.trainer import TrainConfig, Trainer
+
+# the reference's exact print formats (simple_distributed.py:114-117,:130-132)
+TRAIN_RE = re.compile(
+    r"^Train Epoch: (\d+) \[(\d+)/(\d+) \((\d+)%\)\]\tLoss: (\d+\.\d{6})$")
+TEST_RE = re.compile(
+    r"^Test set: Average loss: (\d+\.\d{4}), Accuracy: (\d+)/(\d+) \((\d+)%\)$")
+
+
+def test_console_format_matches_reference(capsys):
+    train, test = synthetic_mnist(n_train=240, n_test=100, seed=3)
+    key = jax.random.key(0)
+    stages, wire_dim, out_dim = make_lenet_stages(key, 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=2)
+    cfg = TrainConfig(epochs=2, batch_size=60, log_interval=2,
+                      print_throughput=False)
+    Trainer(pipe, train, test, cfg).fit()
+
+    out = capsys.readouterr().out
+    lines = [l for l in out.split("\n") if l]
+    train_lines = [l for l in lines if l.startswith("Train Epoch")]
+    test_lines = [l for l in lines if l.startswith("Test set")]
+    assert train_lines and test_lines
+    for l in train_lines:
+        assert TRAIN_RE.match(l), f"bad train line: {l!r}"
+    for l in test_lines:
+        assert TEST_RE.match(l), f"bad test line: {l!r}"
+    # 2 epochs * ceil(4 batches / log_interval 2) = 4 train logs, 2 test logs
+    assert len(train_lines) == 4 and len(test_lines) == 2
+    # first log of an epoch is batch 0 of 240 samples
+    m = TRAIN_RE.match(train_lines[0])
+    assert m.group(2) == "0" and m.group(3) == "240"
+
+
+def test_learns_synthetic_digits():
+    train, test = synthetic_mnist(n_train=240, n_test=100, seed=3)
+    train = Dataset(train.x.reshape(len(train.x), -1), train.y)
+    test = Dataset(test.x.reshape(len(test.x), -1), test.y)
+    stages, wire_dim, out_dim = make_mlp_stages(jax.random.key(0), [784, 64, 10], 2)
+    pipe = Pipeline(stages, make_mesh(n_stages=2, n_data=1), wire_dim, out_dim,
+                    n_microbatches=2)
+    cfg = TrainConfig(epochs=5, batch_size=60, print_throughput=False)
+    trainer = Trainer(pipe, train, test, cfg)
+    trainer.fit()
+    avg_loss, correct = trainer.evaluate()
+    assert correct / 100 > 0.5          # 10% is chance level
+    assert avg_loss < 2.0
