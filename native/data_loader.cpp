@@ -1,0 +1,216 @@
+// Native data loader: IDX parsing + threaded batch prefetch.
+//
+// TPU-native analogue of the reference's native data path (the reference
+// leans on torch's C++ DataLoader machinery and torchvision's MNIST codec;
+// SURVEY §2.3). Exposed to Python via ctypes (no pybind11 in the image —
+// plain C ABI).
+//
+// Two facilities:
+//   1. idx_read / idx_free — parse big-endian IDX files (images or labels)
+//      into a caller-owned float32/int32 buffer, normalizing u8 images to
+//      [0, 1] NHWC.
+//   2. prefetcher_* — a background thread that assembles fixed-size batches
+//      (gather rows by index) into a small ring of pinned host buffers while
+//      the accelerator step runs, hiding host-side batch-assembly latency.
+//
+// Build: make -C native   (produces libsdml_data.so)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- IDX codec
+
+// Reads an IDX file. Returns 0 on success. Caller frees with idx_free.
+//   out_data: float32 buffer (u8 data normalized /255; other dtypes cast)
+//   out_dims: up to 4 dims, unused set to 1; out_ndim: actual rank.
+int idx_read(const char* path, float** out_data, int64_t* out_dims,
+             int* out_ndim) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char magic[4];
+  if (std::fread(magic, 1, 4, f) != 4) { std::fclose(f); return -2; }
+  if (magic[0] != 0 || magic[1] != 0) { std::fclose(f); return -3; }
+  const int dtype = magic[2];  // 0x08 u8, 0x0D f32
+  const int ndim = magic[3];
+  if (ndim < 1 || ndim > 4) { std::fclose(f); return -4; }
+
+  int64_t total = 1;
+  for (int i = 0; i < 4; ++i) out_dims[i] = 1;
+  for (int i = 0; i < ndim; ++i) {
+    unsigned char b[4];
+    if (std::fread(b, 1, 4, f) != 4) { std::fclose(f); return -5; }
+    int64_t d = (int64_t(b[0]) << 24) | (int64_t(b[1]) << 16) |
+                (int64_t(b[2]) << 8) | int64_t(b[3]);
+    out_dims[i] = d;
+    total *= d;
+  }
+  *out_ndim = ndim;
+
+  float* dst = static_cast<float*>(std::malloc(total * sizeof(float)));
+  if (!dst) { std::fclose(f); return -6; }
+
+  if (dtype == 0x08) {  // unsigned byte
+    std::vector<unsigned char> raw(total);
+    if (std::fread(raw.data(), 1, total, f) != size_t(total)) {
+      std::free(dst); std::fclose(f); return -7;
+    }
+    const float inv = 1.0f / 255.0f;
+    // labels (ndim==1) stay as raw values; images normalize to [0,1]
+    const float scale = (ndim == 1) ? 1.0f : inv;
+    for (int64_t i = 0; i < total; ++i) dst[i] = raw[i] * scale;
+  } else if (dtype == 0x0D) {  // big-endian float32
+    std::vector<unsigned char> raw(total * 4);
+    if (std::fread(raw.data(), 1, total * 4, f) != size_t(total) * 4) {
+      std::free(dst); std::fclose(f); return -7;
+    }
+    for (int64_t i = 0; i < total; ++i) {
+      unsigned char* p = &raw[i * 4];
+      uint32_t v = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                   (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+      std::memcpy(&dst[i], &v, 4);
+    }
+  } else {
+    std::free(dst); std::fclose(f); return -8;
+  }
+  std::fclose(f);
+  *out_data = dst;
+  return 0;
+}
+
+void idx_free(float* p) { std::free(p); }
+
+// ------------------------------------------------------------- prefetcher
+
+// Ring-buffered background batch assembly: gathers rows of a source array
+// into batch buffers on a worker thread.
+struct Prefetcher {
+  const float* src_x;      // [n, row_x] row-major
+  const int32_t* src_y;    // [n, row_y]
+  int64_t row_x, row_y, n;
+  int64_t batch;
+  const int64_t* order;    // [n] gather order (epoch permutation), owned copy
+  std::vector<int64_t> order_store;
+
+  int depth;               // ring slots
+  std::vector<std::vector<float>> slot_x;
+  std::vector<std::vector<int32_t>> slot_y;
+  std::vector<int> slot_state;  // 0 empty, 1 full
+  int64_t next_produce = 0, next_consume = 0, n_batches = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+
+  void run() {
+    while (!stop.load()) {
+      int64_t b;
+      int slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        if (next_produce >= n_batches) return;
+        b = next_produce;
+        slot = int(b % depth);
+        cv_empty.wait(lk, [&] {
+          return stop.load() || slot_state[slot] == 0;
+        });
+        if (stop.load()) return;
+        next_produce++;
+      }
+      float* bx = slot_x[slot].data();
+      int32_t* by = slot_y[slot].data();
+      const int64_t start = b * batch;
+      for (int64_t i = 0; i < batch; ++i) {
+        const int64_t src_row =
+            (start + i < n) ? order[start + i] : -1;  // pad with zeros
+        if (src_row >= 0) {
+          std::memcpy(bx + i * row_x, src_x + src_row * row_x,
+                      row_x * sizeof(float));
+          std::memcpy(by + i * row_y, src_y + src_row * row_y,
+                      row_y * sizeof(int32_t));
+        } else {
+          std::memset(bx + i * row_x, 0, row_x * sizeof(float));
+          std::memset(by + i * row_y, 0, row_y * sizeof(int32_t));
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot_state[slot] = 1;
+      }
+      cv_full.notify_one();
+    }
+  }
+};
+
+void* prefetcher_create(const float* x, const int32_t* y, int64_t n,
+                        int64_t row_x, int64_t row_y, int64_t batch,
+                        const int64_t* order, int depth) {
+  auto* p = new Prefetcher();
+  p->src_x = x; p->src_y = y; p->n = n;
+  p->row_x = row_x; p->row_y = row_y; p->batch = batch;
+  p->order_store.assign(order, order + n);
+  p->order = p->order_store.data();
+  p->depth = depth > 0 ? depth : 2;
+  p->n_batches = (n + batch - 1) / batch;
+  p->slot_x.resize(p->depth);
+  p->slot_y.resize(p->depth);
+  p->slot_state.assign(p->depth, 0);
+  for (int i = 0; i < p->depth; ++i) {
+    p->slot_x[i].resize(batch * row_x);
+    p->slot_y[i].resize(batch * row_y);
+  }
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+int64_t prefetcher_num_batches(void* h) {
+  return static_cast<Prefetcher*>(h)->n_batches;
+}
+
+// Blocks until the next batch is assembled; copies it into out_x/out_y.
+// Returns the number of valid rows in the batch, or -1 when exhausted.
+int64_t prefetcher_next(void* h, float* out_x, int32_t* out_y) {
+  auto* p = static_cast<Prefetcher*>(h);
+  int64_t b;
+  int slot;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->next_consume >= p->n_batches) return -1;
+    b = p->next_consume;
+    slot = int(b % p->depth);
+    p->cv_full.wait(lk, [&] { return p->slot_state[slot] == 1; });
+    p->next_consume++;
+  }
+  std::memcpy(out_x, p->slot_x[slot].data(),
+              p->batch * p->row_x * sizeof(float));
+  std::memcpy(out_y, p->slot_y[slot].data(),
+              p->batch * p->row_y * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->slot_state[slot] = 0;
+  }
+  p->cv_empty.notify_one();
+  const int64_t start = b * p->batch;
+  const int64_t valid = (start + p->batch <= p->n) ? p->batch : (p->n - start);
+  return valid;
+}
+
+void prefetcher_destroy(void* h) {
+  auto* p = static_cast<Prefetcher*>(h);
+  p->stop.store(true);
+  p->cv_empty.notify_all();
+  p->cv_full.notify_all();
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+
+}  // extern "C"

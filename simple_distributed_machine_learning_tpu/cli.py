@@ -89,22 +89,22 @@ def main(argv: list[str] | None = None) -> None:
     n_stages = args.stages if args.stages is not None else (2 if n_dev >= 2 else 1)
 
     key = jax.random.key(args.seed)
+    if args.model == "gpt":
+        _run_gpt(args, n_stages, key)
+        return
     if args.model == "lenet":
         from simple_distributed_machine_learning_tpu.models.lenet import (
             make_lenet_stages,
         )
         stages, wire_dim, out_dim = make_lenet_stages(key, n_stages)
         in_is_image = True
-    elif args.model == "mlp":
+    else:
         from simple_distributed_machine_learning_tpu.models.mlp import (
             make_mlp_stages,
         )
         dims = [int(d) for d in args.mlp_dims.split(",")]
         stages, wire_dim, out_dim = make_mlp_stages(key, dims, n_stages)
         in_is_image = False
-    else:
-        raise NotImplementedError(
-            "gpt training via CLI lands with the gpt model module")
 
     from simple_distributed_machine_learning_tpu.data.mnist import (
         Dataset,
@@ -123,6 +123,41 @@ def main(argv: list[str] | None = None) -> None:
 
     mesh = make_mesh(n_stages=n_stages, n_data=args.dp)
     pipe = Pipeline(stages, mesh, wire_dim, out_dim,
+                    n_microbatches=args.microbatches)
+    config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
+                         learning_rate=args.lr, momentum=args.momentum,
+                         seed=args.seed)
+    Trainer(pipe, train_ds, test_ds, config).fit()
+
+
+def _run_gpt(args, n_stages: int, key) -> None:
+    """--model gpt: tiny-GPT LM on a synthetic Markov token stream
+    (BASELINE.json config 5), same trainer/console surface."""
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.data.mnist import Dataset
+    from simple_distributed_machine_learning_tpu.data.text import synthetic_tokens
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+    from simple_distributed_machine_learning_tpu.train.trainer import (
+        TrainConfig,
+        Trainer,
+    )
+
+    cfg = GPTConfig()
+    stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
+    # one Markov chain, disjoint train/test sequences (a different seed would
+    # regenerate a different transition matrix — nothing would transfer)
+    all_data = synthetic_tokens(7000, cfg.seq_len, cfg.vocab, seed=args.seed)
+    train_ds = Dataset(all_data.x[:6000].astype(np.float32), all_data.y[:6000])
+    test_ds = Dataset(all_data.x[6000:].astype(np.float32), all_data.y[6000:])
+
+    mesh = make_mesh(n_stages=n_stages, n_data=args.dp)
+    pipe = Pipeline(stages, mesh, wire_dim, out_shape,
                     n_microbatches=args.microbatches)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,

@@ -152,5 +152,5 @@ def batches(ds: Dataset, batch_size: int, pad_last: bool = True
                 return
             pad = batch_size - n_valid
             x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-            y = np.concatenate([y, np.zeros((pad,), y.dtype)])
+            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
         yield Batch(x, y, n_valid)

@@ -1,0 +1,38 @@
+"""Synthetic token streams for language-model training (zero-egress).
+
+A deterministic order-1 Markov chain over the vocabulary: structure a 2-layer
+GPT can learn (next-token entropy well below uniform), generated hermetically
+— the LM analogue of ``mnist.synthetic_mnist``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class LMData(NamedTuple):
+    x: np.ndarray        # [N, T] int32 input tokens
+    y: np.ndarray        # [N, T] int32 next-token targets
+
+
+def synthetic_tokens(n_seqs: int, seq_len: int, vocab: int,
+                     seed: int = 0) -> LMData:
+    rng = np.random.default_rng(seed)
+    # peaked transition matrix: each token has ~4 likely successors
+    logits = rng.normal(size=(vocab, vocab)).astype(np.float32)
+    top = np.argsort(logits, axis=1)[:, -4:]
+    boost = np.zeros_like(logits)
+    np.put_along_axis(boost, top, 4.0, axis=1)
+    p = np.exp(logits * 0.1 + boost)
+    p /= p.sum(1, keepdims=True)
+    logp = np.log(p)
+
+    toks = np.empty((n_seqs, seq_len + 1), np.int64)
+    toks[:, 0] = rng.integers(0, vocab, n_seqs)
+    for t in range(seq_len):
+        g = rng.gumbel(size=(n_seqs, vocab))
+        toks[:, t + 1] = np.argmax(logp[toks[:, t]] + g, axis=1)
+    toks = toks.astype(np.int32)
+    return LMData(toks[:, :-1], toks[:, 1:])

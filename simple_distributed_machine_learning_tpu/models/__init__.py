@@ -5,4 +5,11 @@ LeNet with the reference's conv↔fc split, and a tiny GPT with GPipe
 microbatching.
 """
 
+from simple_distributed_machine_learning_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    make_gpt_stages,
+)
+from simple_distributed_machine_learning_tpu.models.lenet import (  # noqa: F401
+    make_lenet_stages,
+)
 from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages  # noqa: F401

@@ -1,0 +1,133 @@
+"""Tiny GPT as pipeline stages (BASELINE.json config 5).
+
+A decoder-only transformer LM — token+position embeddings, pre-LN blocks
+(causal MHA + GELU MLP), final LN + untied head + log_softmax — expressed in
+the same :class:`~..parallel.pipeline.Stage` form as MLP/LeNet, so the exact
+GPipe/ppermute machinery that runs the reference's conv↔fc split also runs a
+transformer with per-token next-token loss.
+
+The reference has no attention or sequence models at all (SURVEY §5.7); this
+is pure capability extension mandated by the driver's config 5 ("2-layer
+tiny-GPT d=128, 2-stage pipeline with GPipe microbatching").
+
+Wire notes: stage 0 consumes tokens (cast to float on the wire, exact for any
+realistic vocab), emits the [T, d] hidden state; the last stage emits [T, V]
+log-probs. The engine's per-token loss path (``Pipeline(out_dim=(T, V))``)
+averages NLL over batch and sequence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from simple_distributed_machine_learning_tpu.ops.attention import (
+    causal_attention,
+    mha_init,
+)
+from simple_distributed_machine_learning_tpu.ops.layers import (
+    dropout,
+    embedding_init,
+    embedding_lookup,
+    layer_norm,
+    layer_norm_init,
+    linear,
+    linear_init,
+)
+from simple_distributed_machine_learning_tpu.ops.losses import log_softmax
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Stage
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab: int = 128
+    seq_len: int = 64
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0   # tiny-GPT default: no dropout
+
+
+def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    d, dh = cfg.d_model, cfg.mlp_ratio * cfg.d_model
+    return {
+        "ln1": layer_norm_init(d),
+        "attn": mha_init(k1, d, cfg.n_heads),
+        "ln2": layer_norm_init(d),
+        "mlp_in": linear_init(k2, d, dh),
+        "mlp_out": linear_init(k3, dh, d),
+    }
+
+
+def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
+                 deterministic: bool) -> jax.Array:
+    k1, k2 = jax.random.split(key)
+    a = causal_attention(params["attn"], layer_norm(params["ln1"], h),
+                         cfg.n_heads)
+    a = dropout(k1, a, cfg.dropout_rate, deterministic)
+    h = h + a
+    m = linear(params["mlp_out"],
+               jax.nn.gelu(linear(params["mlp_in"], layer_norm(params["ln2"], h))))
+    m = dropout(k2, m, cfg.dropout_rate, deterministic)
+    return h + m
+
+
+def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
+                    n_stages: int = 2) -> tuple[list[Stage], int, tuple[int, int]]:
+    """Build the GPT as ``n_stages`` pipeline stages.
+
+    Blocks are split contiguously; stage 0 additionally owns the embeddings,
+    the last stage owns the final LN + head. Returns
+    ``(stages, wire_dim, (seq_len, vocab))`` — pass the tuple as the
+    Pipeline's ``out_dim`` for the per-token loss.
+    """
+    if cfg.n_layers < n_stages and not (n_stages == 1 and cfg.n_layers == 0):
+        raise ValueError(
+            f"{cfg.n_layers} layers cannot fill {n_stages} stages")
+    ke, kp, kh, *kb = jax.random.split(key, 3 + cfg.n_layers)
+    embed = {"tok": embedding_init(ke, cfg.vocab, cfg.d_model),
+             "pos": 0.02 * jax.random.normal(kp, (cfg.seq_len, cfg.d_model))}
+    blocks = [_block_init(kb[i], cfg) for i in range(cfg.n_layers)]
+    head = {"ln_f": layer_norm_init(cfg.d_model),
+            "out": linear_init(kh, cfg.d_model, cfg.vocab)}
+
+    per = [cfg.n_layers // n_stages + (1 if i < cfg.n_layers % n_stages else 0)
+           for i in range(n_stages)]
+
+    stages: list[Stage] = []
+    start = 0
+    for s in range(n_stages):
+        stage_blocks = blocks[start:start + per[s]]
+        first, last = s == 0, s == n_stages - 1
+        params: dict = {"blocks": stage_blocks}
+        if first:
+            params["embed"] = embed
+        if last:
+            params["head"] = head
+
+        def apply(params, x, key, deterministic,
+                  _first=first, _last=last, _n=len(stage_blocks)):
+            if _first:
+                ids = x.astype(jnp.int32)                     # tokens on the wire
+                h = (embedding_lookup(params["embed"]["tok"], ids)
+                     + params["embed"]["pos"])
+            else:
+                h = x                                         # [B, T, d]
+            for i in range(_n):
+                h = _block_apply(params["blocks"][i], h, cfg,
+                                 jax.random.fold_in(key, i), deterministic)
+            if _last:
+                h = layer_norm(params["head"]["ln_f"], h)
+                return log_softmax(linear(params["head"]["out"], h))
+            return h
+
+        in_shape = (cfg.seq_len,) if first else (cfg.seq_len, cfg.d_model)
+        stages.append(Stage(apply=apply, params=params, in_shape=in_shape))
+        start += per[s]
+
+    wire_dim = cfg.seq_len * max(cfg.d_model, cfg.vocab)
+    return stages, wire_dim, (cfg.seq_len, cfg.vocab)

@@ -102,6 +102,27 @@ def relu(x: jax.Array) -> jax.Array:
     return jax.nn.relu(x)
 
 
+def layer_norm_init(d: int, dtype=jnp.float32) -> dict:
+    return {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)}
+
+
+def layer_norm(params: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """LayerNorm over the trailing feature axis."""
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * params["scale"] + params["bias"]
+
+
+def embedding_init(key: jax.Array, vocab: int, d: int,
+                   dtype=jnp.float32) -> jax.Array:
+    """Token-embedding table [vocab, d] (normal 0.02, GPT convention)."""
+    return 0.02 * jax.random.normal(key, (vocab, d), dtype)
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+    return jnp.take(table, ids, axis=0)
+
+
 def dropout(key: jax.Array, x: jax.Array, rate: float = 0.5,
             deterministic: bool = False) -> jax.Array:
     """Inverted dropout (``F.dropout`` equivalent, explicit key & mode)."""

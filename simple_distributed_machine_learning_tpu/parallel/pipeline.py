@@ -73,7 +73,8 @@ class Pipeline:
     """
 
     def __init__(self, stages: Sequence[Stage], mesh: jax.sharding.Mesh,
-                 wire_dim: int, out_dim: int, n_microbatches: int = 1):
+                 wire_dim: int, out_dim: int | tuple[int, ...],
+                 n_microbatches: int = 1):
         self.stages = list(stages)
         self.mesh = mesh
         self.n_stages = mesh.shape[STAGE_AXIS]
@@ -82,7 +83,11 @@ class Pipeline:
             raise ValueError(
                 f"{len(self.stages)} stages but mesh stage axis is {self.n_stages}")
         self.wire_dim = int(wire_dim)
-        self.out_dim = int(out_dim)
+        # per-sample output shape; last axis = classes. (C,) for classifiers,
+        # (T, V) for per-token language-model log-probs
+        self.out_shape = ((int(out_dim),) if isinstance(out_dim, int)
+                          else tuple(int(d) for d in out_dim))
+        self.out_dim = self.out_shape[-1]
         self.n_microbatches = int(n_microbatches)
         self._sm_cache: dict[bool, Callable] = {}
         self._buf0, self.metas = pack_stage_params([s.params for s in self.stages])
@@ -115,9 +120,9 @@ class Pipeline:
                         f"stage {s} outputs {out_size} features but stage "
                         f"{s + 1} declares in_shape={self.stages[s + 1].in_shape} "
                         f"({nxt} features)")
-            elif out.shape[1:] != (self.out_dim,):
+            elif out.shape[1:] != self.out_shape:
                 raise ValueError(
-                    f"last stage must output [batch, {self.out_dim}], got "
+                    f"last stage must output [batch, *{self.out_shape}], got "
                     f"{out.shape}")
             if int(np.prod(stage.in_shape)) > self.wire_dim:
                 raise ValueError(
@@ -148,7 +153,7 @@ class Pipeline:
         M = self.n_microbatches
         T = M + S - 1
         wire_dim = self.wire_dim
-        out_dim = self.out_dim
+        out_shape = self.out_shape
         metas = list(self.metas)
         applies = [s.apply for s in self.stages]
         in_shapes = [s.in_shape for s in self.stages]
@@ -188,14 +193,18 @@ class Pipeline:
                 valid = (m >= 0) & (m < M)
                 out = jnp.where(valid, out, jnp.zeros_like(out))
                 # last stage just produced log-probs for microbatch m
-                logits = wire_decode(out, (out_dim,))
+                logits = wire_decode(out, out_shape)
                 is_out = valid & (stage == S - 1)
                 m_safe = jnp.clip(m, 0, M - 1)
                 tgt = lax.dynamic_index_in_dim(tgt_mb, m_safe, 0, keepdims=False)
                 w = lax.dynamic_index_in_dim(w_mb, m_safe, 0, keepdims=False)
-                per_ex = nll_loss(logits, tgt, "none") * w
-                num_acc = num_acc + jnp.where(is_out, jnp.sum(per_ex), 0.0)
-                den_acc = den_acc + jnp.where(is_out, jnp.sum(w), 0.0)
+                # per-sample weights broadcast over any token axes (e.g. the
+                # sequence axis of a per-token LM loss)
+                nll = nll_loss(logits, tgt, "none")
+                wb = w.reshape(w.shape + (1,) * (nll.ndim - 1))
+                per_tok = jnp.broadcast_to(wb, nll.shape)
+                num_acc = num_acc + jnp.where(is_out, jnp.sum(nll * per_tok), 0.0)
+                den_acc = den_acc + jnp.where(is_out, jnp.sum(per_tok), 0.0)
                 prev = lax.dynamic_index_in_dim(logits_acc, m_safe, 0, keepdims=False)
                 logits_acc = lax.dynamic_update_index_in_dim(
                     logits_acc, jnp.where(is_out, logits, prev), m_safe, 0)
@@ -206,7 +215,7 @@ class Pipeline:
 
             init = (jnp.zeros((mb, wire_dim), x_mb.dtype),
                     jnp.float32(0.0), jnp.float32(0.0),
-                    jnp.zeros((M, mb, out_dim), jnp.float32))
+                    jnp.zeros((M, mb) + out_shape, jnp.float32))
             (_, num, den, logits_acc), _ = lax.scan(step, init, jnp.arange(T))
 
             # weighted global mean: sum(w * nll) / sum(w), reduced over the
@@ -222,7 +231,7 @@ class Pipeline:
             mesh=self.mesh,
             in_specs=(P(STAGE_AXIS, None), P(None, DATA_AXIS, None),
                       P(None, DATA_AXIS), P(None, DATA_AXIS), P()),
-            out_specs=(P(), P(None, DATA_AXIS, None)),
+            out_specs=(P(), P(None, DATA_AXIS)),
             check_vma=False,
         )
         self._sm_cache[deterministic] = fn
@@ -247,12 +256,15 @@ class Pipeline:
         if B % (M * self.n_data) != 0:
             raise ValueError(
                 f"batch {B} not divisible by microbatches*data = {M * self.n_data}")
-        xw = wire_encode(x, self.wire_dim).reshape(M, B // M, self.wire_dim)
-        tgt = targets.reshape(M, B // M)
+        # the wire is always float32 (stages decode/cast as needed — e.g. the
+        # GPT embedding stage reads token ids back out of the float wire)
+        xw = wire_encode(x, self.wire_dim).astype(jnp.float32).reshape(
+            M, B // M, self.wire_dim)
+        tgt = targets.reshape((M, B // M) + self.out_shape[:-1])
         w = (jnp.ones((B,), jnp.float32) if weights is None
              else weights.astype(jnp.float32)).reshape(M, B // M)
         loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, w, key)
-        return loss, logits.reshape(B, self.out_dim)
+        return loss, logits.reshape((B,) + self.out_shape)
 
 
 def fused_reference(stages: Sequence[Stage]) -> Callable:

@@ -93,9 +93,12 @@ def make_eval_step(pipe: Pipeline):
     @jax.jit
     def step(buf, x, targets, key, n_valid):
         _, logp = pipe.loss_and_logits(buf, x, targets, key, deterministic=True)
+        # per-sample mask, broadcast over any token axes (LM targets [B, T])
         mask = (jnp.arange(x.shape[0]) < n_valid).astype(jnp.float32)
+        mask = mask.reshape((x.shape[0],) + (1,) * (targets.ndim - 1))
         sum_loss = jnp.sum(nll_loss(logp, targets, reduction="none") * mask)
-        correct = jnp.sum((logp.argmax(-1) == targets) * mask.astype(jnp.int32))
+        correct = jnp.sum(((logp.argmax(-1) == targets)
+                           * mask).astype(jnp.int32))
         return sum_loss, correct
 
     return step

@@ -111,7 +111,9 @@ class Trainer:
         cfg = self.config
         total_loss = 0.0
         correct = 0
-        n = len(self.test_ds.x)
+        # prediction units: samples for classifiers (y: [N]), tokens for
+        # language models (y: [N, T]) — y.size covers both
+        n = int(self.test_ds.y.size)
         for b in batches(self.test_ds, cfg.batch_size, pad_last=True):
             sl, c = self._eval_step(self.buf, b.x, b.y, self._key,
                                     np.int32(b.n_valid))

@@ -1,0 +1,32 @@
+"""Profiling hooks (SURVEY §5.1: absent in the reference, cheap under JAX).
+
+Wraps ``jax.profiler`` so any training window can be captured as an XProf /
+TensorBoard trace — the tool for verifying the pipeline actually overlaps
+ICI transfer with compute (the ≥10× claim's mechanism, SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/sdml_trace", enabled: bool = True):
+    """``with trace('/tmp/tb'): step(...)`` → open in TensorBoard/XProf."""
+    if not enabled:
+        yield
+        return
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)

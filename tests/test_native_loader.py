@@ -1,0 +1,65 @@
+"""Native C++ loader: IDX codec parity with the NumPy path + prefetcher."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.data import native_loader
+from simple_distributed_machine_learning_tpu.data.mnist import _read_idx
+
+pytestmark = pytest.mark.skipif(not native_loader.available(),
+                                reason="native toolchain unavailable")
+
+
+def _write_idx_images(path, arr_u8):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000800 | arr_u8.ndim))
+        for d in arr_u8.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr_u8.tobytes())
+
+
+def test_idx_codec_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(5, 28, 28), dtype=np.uint8)
+    p = str(tmp_path / "imgs-idx3-ubyte")
+    _write_idx_images(p, imgs)
+    native = native_loader.idx_read_native(p)
+    want = _read_idx(p).astype(np.float32) / 255.0
+    np.testing.assert_allclose(native, want, rtol=1e-6)
+
+    labels = rng.integers(0, 10, size=(5,), dtype=np.uint8)
+    p2 = str(tmp_path / "labels-idx1-ubyte")
+    _write_idx_images(p2, labels)
+    native_l = native_loader.idx_read_native(p2)
+    np.testing.assert_array_equal(native_l, labels.astype(np.float32))
+
+
+def test_prefetcher_yields_same_batches_as_numpy_path():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(25, 4, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=(25,)).astype(np.int32)
+    pf = native_loader.NativePrefetcher(x, y, batch=10)
+    got = list(pf)
+    pf.close()
+    assert len(got) == 3
+    np.testing.assert_allclose(got[0][0], x[:10])
+    np.testing.assert_array_equal(got[0][1], y[:10])
+    assert got[0][2] == 10
+    # ragged tail: 5 valid rows, zero-padded to 10
+    np.testing.assert_allclose(got[2][0][:5], x[20:])
+    assert got[2][2] == 5
+    np.testing.assert_allclose(got[2][0][5:], 0.0)
+
+
+def test_prefetcher_custom_order():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.arange(6, dtype=np.int32)
+    order = np.array([5, 4, 3, 2, 1, 0])
+    pf = native_loader.NativePrefetcher(x, y, batch=3, order=order)
+    got = list(pf)
+    pf.close()
+    np.testing.assert_array_equal(got[0][1], [5, 4, 3])
+    np.testing.assert_allclose(got[0][0], x[[5, 4, 3]])
