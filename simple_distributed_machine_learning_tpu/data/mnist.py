@@ -52,7 +52,15 @@ def _find(root: str, stem: str) -> str | None:
 
 
 def load_idx_mnist(root: str) -> tuple[Dataset, Dataset] | None:
-    """Load real MNIST from IDX files under ``root``; None if absent."""
+    """Load real MNIST from IDX files under ``root``; None if absent.
+
+    Non-gzip files go through the native C++ IDX codec
+    (``native/data_loader.cpp``) when the toolchain is available — it returns
+    images already normalized to [0, 1] float32 — with the pure-NumPy parser
+    as fallback (and for .gz files, which the native codec does not decode).
+    """
+    from simple_distributed_machine_learning_tpu.data import native_loader
+
     paths = {k: _find(root, s) for k, s in {
         "train_x": "train-images-idx3-ubyte",
         "train_y": "train-labels-idx1-ubyte",
@@ -61,12 +69,21 @@ def load_idx_mnist(root: str) -> tuple[Dataset, Dataset] | None:
     }.items()}
     if any(v is None for v in paths.values()):
         return None
+
+    native_ok = native_loader.available()
+
     def imgs(p):
+        if native_ok and not p.endswith(".gz"):
+            return native_loader.idx_read_native(p)[..., None]
         return (_read_idx(p).astype(np.float32) / 255.0)[..., None]
-    train = Dataset(imgs(paths["train_x"]),
-                    _read_idx(paths["train_y"]).astype(np.int32))
-    test = Dataset(imgs(paths["test_x"]),
-                   _read_idx(paths["test_y"]).astype(np.int32))
+
+    def labels(p):
+        if native_ok and not p.endswith(".gz"):
+            return native_loader.idx_read_native(p).astype(np.int32)
+        return _read_idx(p).astype(np.int32)
+
+    train = Dataset(imgs(paths["train_x"]), labels(paths["train_y"]))
+    test = Dataset(imgs(paths["test_x"]), labels(paths["test_y"]))
     return train, test
 
 
@@ -154,3 +171,23 @@ def batches(ds: Dataset, batch_size: int, pad_last: bool = True
             x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
             y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
         yield Batch(x, y, n_valid)
+
+
+def prefetch_batches(ds: Dataset, batch_size: int) -> Iterator[Batch]:
+    """Like :func:`batches` (pad_last semantics) but batch assembly runs on
+    the native C++ prefetcher thread (``native/data_loader.cpp``) when the
+    toolchain is available, overlapping gather/pad with the device step —
+    the TPU-side analogue of the torch DataLoader worker the reference leans
+    on (SURVEY §2.3). Falls back to the pure-Python iterator transparently.
+    """
+    from simple_distributed_machine_learning_tpu.data import native_loader
+
+    if not native_loader.available():
+        yield from batches(ds, batch_size, pad_last=True)
+        return
+    pf = native_loader.NativePrefetcher(ds.x, ds.y, batch_size)
+    try:
+        for bx, by, n_valid in pf:
+            yield Batch(bx, by.astype(ds.y.dtype, copy=False), n_valid)
+    finally:
+        pf.close()

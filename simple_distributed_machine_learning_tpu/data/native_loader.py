@@ -26,11 +26,14 @@ def _load() -> ctypes.CDLL | None:
     global _lib
     if _lib is not None:
         return _lib or None  # False (cached failure) -> None
-    if not os.path.exists(_SO_PATH):
-        try:
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
+    # always invoke make: it is a no-op when the .so is newer than the
+    # sources, and rebuilds when data_loader.cpp changed (a pre-existing .so
+    # must never mask an edited source file)
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except Exception:
+        if not os.path.exists(_SO_PATH):
             _lib = False
             return None
     try:

@@ -49,6 +49,7 @@ class GPTConfig:
     n_layers: int = 2
     mlp_ratio: int = 4
     dropout_rate: float = 0.0   # tiny-GPT default: no dropout
+    attn_impl: str = "dense"    # "dense" | "flash" (Pallas fused kernel)
 
 
 def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
@@ -66,8 +67,15 @@ def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
 def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
                  deterministic: bool) -> jax.Array:
     k1, k2 = jax.random.split(key)
-    a = causal_attention(params["attn"], layer_norm(params["ln1"], h),
-                         cfg.n_heads)
+    if cfg.attn_impl == "flash":
+        from simple_distributed_machine_learning_tpu.ops.flash_attention import (
+            flash_mha,
+        )
+        a = flash_mha(params["attn"], layer_norm(params["ln1"], h),
+                      cfg.n_heads)
+    else:
+        a = causal_attention(params["attn"], layer_norm(params["ln1"], h),
+                             cfg.n_heads)
     a = dropout(k1, a, cfg.dropout_rate, deterministic)
     h = h + a
     m = linear(params["mlp_out"],

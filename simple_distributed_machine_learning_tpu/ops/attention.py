@@ -48,19 +48,30 @@ def _merge_heads(x: jax.Array) -> jax.Array:
     return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
 
 
+def causal_attention_core(q: jax.Array, k: jax.Array,
+                          v: jax.Array) -> jax.Array:
+    """Dense causal softmax attention on split heads: [B, H, T, Dh] each.
+
+    The single source of the masked-softmax math — reused by
+    :func:`causal_attention` and the Ulysses sequence-parallel path
+    (``parallel/sequence.py``); the Pallas kernel and ring attention are
+    tested against it.
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(dh)
+    t = q.shape[2]
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+
+
 def causal_attention(params: dict, x: jax.Array, n_heads: int) -> jax.Array:
     """Standard causal MHA on one device. x: [B, T, D] -> [B, T, D]."""
     h = n_heads
     q = _split_heads(x @ params["wq"], h)
     k = _split_heads(x @ params["wk"], h)
     v = _split_heads(x @ params["wv"], h)
-    dh = q.shape[-1]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(dh)
-    t = x.shape[1]
-    mask = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(mask, scores, -jnp.inf)
-    out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
-    return _merge_heads(out) @ params["wo"]
+    return _merge_heads(causal_attention_core(q, k, v)) @ params["wo"]
 
 
 def _block_accumulate(q, k, v, acc, q_off, k_off, scale):

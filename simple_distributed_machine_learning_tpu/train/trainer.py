@@ -19,7 +19,11 @@ import dataclasses
 import jax
 import numpy as np
 
-from simple_distributed_machine_learning_tpu.data.mnist import Dataset, batches
+from simple_distributed_machine_learning_tpu.data.mnist import (
+    Dataset,
+    batches,
+    prefetch_batches,
+)
 from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
 from simple_distributed_machine_learning_tpu.train.optimizer import Optimizer, sgd
 from simple_distributed_machine_learning_tpu.train.step import (
@@ -78,8 +82,10 @@ class Trainer:
         n_total = len(self.train_ds.x)
         n_batches = max(1, (n_total + cfg.batch_size - 1) // cfg.batch_size)
         loss = 0.0
+        # batch assembly on the native C++ prefetcher thread when available
+        # (transparent python fallback), overlapped with the device step
         for batch_idx, b in enumerate(
-                batches(self.train_ds, cfg.batch_size, pad_last=True)):
+                prefetch_batches(self.train_ds, cfg.batch_size)):
             key = jax.random.fold_in(self._key, self._step_count)
             # ragged final batch: zero-padded, masked out of the loss mean
             # (the reference just trains on the short batch, :108-113; the

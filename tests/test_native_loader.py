@@ -63,3 +63,25 @@ def test_prefetcher_custom_order():
     pf.close()
     np.testing.assert_array_equal(got[0][1], [5, 4, 3])
     np.testing.assert_allclose(got[0][0], x[[5, 4, 3]])
+
+
+def test_prefetch_batches_matches_python_iterator():
+    """The Trainer's prefetch path yields exactly what batches() yields."""
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.data.mnist import (
+        Dataset,
+        batches,
+        prefetch_batches,
+    )
+
+    rng = np.random.default_rng(0)
+    ds = Dataset(rng.normal(size=(25, 4, 4, 1)).astype(np.float32),
+                 rng.integers(0, 10, size=25).astype(np.int32))
+    got = list(prefetch_batches(ds, 10))
+    want = list(batches(ds, 10, pad_last=True))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g.x, w.x)
+        np.testing.assert_array_equal(g.y, w.y)
+        assert g.n_valid == w.n_valid
