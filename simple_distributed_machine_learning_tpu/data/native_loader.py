@@ -9,6 +9,7 @@ pure-NumPy paths in ``mnist.py``.
 from __future__ import annotations
 
 import ctypes
+import fcntl
 import os
 import subprocess
 from typing import Iterator
@@ -28,10 +29,14 @@ def _load() -> ctypes.CDLL | None:
         return _lib or None  # False (cached failure) -> None
     # always invoke make: it is a no-op when the .so is newer than the
     # sources, and rebuilds when data_loader.cpp changed (a pre-existing .so
-    # must never mask an edited source file)
+    # must never mask an edited source file). flock serializes concurrent
+    # processes (every rank of a multi-process launch lands here at startup)
+    # so none can dlopen a half-written .so.
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
+        with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
     except Exception:
         if not os.path.exists(_SO_PATH):
             _lib = False

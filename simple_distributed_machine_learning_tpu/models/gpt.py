@@ -51,6 +51,11 @@ class GPTConfig:
     dropout_rate: float = 0.0   # tiny-GPT default: no dropout
     attn_impl: str = "dense"    # "dense" | "flash" (Pallas fused kernel)
 
+    def __post_init__(self):
+        if self.attn_impl not in ("dense", "flash"):
+            raise ValueError(
+                f"attn_impl must be 'dense' or 'flash', got {self.attn_impl!r}")
+
 
 def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
     k1, k2, k3 = jax.random.split(key, 3)

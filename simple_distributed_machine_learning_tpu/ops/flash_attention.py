@@ -141,11 +141,9 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
     grid = (bh, tq // block_q, tk // block_k)
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, t_real=t, scale=scale)
-    compiler_params = None
-    if _HAS_PLTPU:
-        # bh and q-blocks are independent; the k axis carries scratch state
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    # bh and q-blocks are independent; the k axis carries scratch state
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
     o, l, m = pl.pallas_call(
         kernel,
         grid=grid,

@@ -11,6 +11,7 @@ import pytest
 
 from simple_distributed_machine_learning_tpu.ops.attention import (
     causal_attention,
+    causal_attention_core,
     mha_init,
 )
 from simple_distributed_machine_learning_tpu.ops.flash_attention import (
@@ -18,15 +19,9 @@ from simple_distributed_machine_learning_tpu.ops.flash_attention import (
     flash_mha,
 )
 
-
-def _dense_reference(q, k, v):
-    """Plain causal softmax attention on [B, H, T, Dh]."""
-    dh = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(dh))
-    t = q.shape[2]
-    mask = jnp.tril(jnp.ones((t, t), bool))
-    s = jnp.where(mask, s, -jnp.inf)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+# the canonical masked-softmax math from ops/attention.py — the kernel is
+# verified against the same code every other attention path uses
+_dense_reference = causal_attention_core
 
 
 @pytest.mark.parametrize("t,dh,bq,bk", [
