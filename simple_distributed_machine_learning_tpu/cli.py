@@ -69,10 +69,36 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--seed', type=int, default=0)
     g.add_argument('--mlp-dims', type=str, default="784,512,10",
                    help="comma-separated layer widths for --model=mlp")
+    g.add_argument('--experts', type=int, default=0,
+                   help="for --model=gpt: replace each block's MLP with a "
+                        "top-2-routed mixture of this many experts (0 = dense)")
     return p
 
 
+def _apply_env_platform() -> None:
+    """Honor JAX_PLATFORMS / xla_force_host_platform_device_count even when a
+    sitecustomize imported jax at interpreter startup (which latches the
+    platform choice before env vars are read — seen with preloaded TPU
+    plugins). Re-applies both through the live config; harmless no-op if
+    backends are already initialized."""
+    import os
+    import re
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        jax.config.update("jax_platforms", plat)
+        m = re.search(r"xla_force_host_platform_device_count=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        if m and plat == "cpu":
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+    except RuntimeError:
+        pass  # backends already up: keep whatever exists
+
+
 def main(argv: list[str] | None = None) -> None:
+    _apply_env_platform()
     args = build_parser().parse_args(argv)
     assert args.rank is not None or args.world_size == 1, \
         "Must provide rank argument."  # reference :160
@@ -148,7 +174,8 @@ def _run_gpt(args, n_stages: int, key) -> None:
         Trainer,
     )
 
-    cfg = GPTConfig()
+    cfg = GPTConfig(n_experts=args.experts,
+                    moe_top_k=min(2, max(1, args.experts)))
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
     # one Markov chain, disjoint train/test sequences (a different seed would
     # regenerate a different transition matrix — nothing would transfer)

@@ -50,23 +50,43 @@ class GPTConfig:
     mlp_ratio: int = 4
     dropout_rate: float = 0.0   # tiny-GPT default: no dropout
     attn_impl: str = "dense"    # "dense" | "flash" (Pallas fused kernel)
+    # MoE: n_experts > 0 replaces each block's MLP with a mixture-of-experts
+    # FFN (top-k routed, see parallel/expert.py). Inside the pipeline the MoE
+    # runs dense per stage with a generous capacity (the router's Switch aux
+    # loss is exposed via expert.moe_apply for standalone use; the pipeline's
+    # NLL-only loss path does not add it — acceptable at tiny expert counts).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
 
     def __post_init__(self):
         if self.attn_impl not in ("dense", "flash"):
             raise ValueError(
                 f"attn_impl must be 'dense' or 'flash', got {self.attn_impl!r}")
+        if self.n_experts < 0 or (self.n_experts > 0 and not
+                                  1 <= self.moe_top_k <= self.n_experts):
+            raise ValueError(
+                f"invalid MoE config: n_experts={self.n_experts}, "
+                f"top_k={self.moe_top_k}")
 
 
 def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
     k1, k2, k3 = jax.random.split(key, 3)
     d, dh = cfg.d_model, cfg.mlp_ratio * cfg.d_model
-    return {
+    p = {
         "ln1": layer_norm_init(d),
         "attn": mha_init(k1, d, cfg.n_heads),
         "ln2": layer_norm_init(d),
-        "mlp_in": linear_init(k2, d, dh),
-        "mlp_out": linear_init(k3, dh, d),
     }
+    if cfg.n_experts > 0:
+        from simple_distributed_machine_learning_tpu.parallel.expert import (
+            moe_init,
+        )
+        p["moe"] = moe_init(k2, d, dh, cfg.n_experts)
+    else:
+        p["mlp_in"] = linear_init(k2, d, dh)
+        p["mlp_out"] = linear_init(k3, dh, d)
+    return p
 
 
 def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
@@ -83,8 +103,22 @@ def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
                              cfg.n_heads)
     a = dropout(k1, a, cfg.dropout_rate, deterministic)
     h = h + a
-    m = linear(params["mlp_out"],
-               jax.nn.gelu(linear(params["mlp_in"], layer_norm(params["ln2"], h))))
+    hn = layer_norm(params["ln2"], h)
+    if cfg.n_experts > 0:
+        from simple_distributed_machine_learning_tpu.parallel.expert import (
+            default_capacity,
+            moe_apply,
+        )
+        # route per sequence (vmap over batch): keeps the [T, E, C] dispatch
+        # tensors at seq_len scale instead of batch*seq_len (C grows with the
+        # routed group size, so global routing would cost O((B*T)^2/E))
+        cap = default_capacity(hn.shape[1], cfg.n_experts, cfg.moe_top_k,
+                               cfg.moe_capacity_factor)
+        m, _aux = jax.vmap(
+            lambda t: moe_apply(params["moe"], t, k=cfg.moe_top_k,
+                                capacity=cap))(hn)
+    else:
+        m = linear(params["mlp_out"], jax.nn.gelu(linear(params["mlp_in"], hn)))
     m = dropout(k2, m, cfg.dropout_rate, deterministic)
     return h + m
 

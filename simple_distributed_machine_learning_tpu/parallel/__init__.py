@@ -27,3 +27,9 @@ from simple_distributed_machine_learning_tpu.parallel.pipeline import (  # noqa:
     Pipeline,
     Stage,
 )
+from simple_distributed_machine_learning_tpu.parallel.expert import (  # noqa: F401
+    EXPERT_AXIS,
+    moe_apply,
+    moe_apply_ep,
+    moe_init,
+)

@@ -1,0 +1,117 @@
+"""Expert parallelism: EP MoE (all_to_all over an expert mesh axis) must
+compute exactly what the dense MoE computes on each token shard, values and
+grads; capacity semantics drop overflow tokens to zero output."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from simple_distributed_machine_learning_tpu.parallel.expert import (
+    moe_apply,
+    moe_apply_ep,
+    moe_init,
+)
+
+D_MODEL, D_HIDDEN, N_EXPERTS, N_SHARDS, T_LOCAL = 16, 32, 8, 4, 12
+
+
+def _ep_fn(mesh, k, capacity):
+    espec = jax.tree.map(lambda _: P("expert"),
+                         {"in": {"w": 0, "b": 0}, "out": {"w": 0, "b": 0}})
+    pspec = {"router": P(), "experts": espec}
+
+    def per_device(p, xx):
+        return moe_apply_ep(p, xx, k=k, capacity=capacity)
+
+    return jax.jit(jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pspec, P("expert")), out_specs=(P("expert"), P()),
+        check_vma=False))
+
+
+def test_ep_matches_dense_per_shard():
+    key = jax.random.key(0)
+    params = moe_init(key, D_MODEL, D_HIDDEN, N_EXPERTS)
+    x = jax.random.normal(jax.random.key(1), (N_SHARDS * T_LOCAL, D_MODEL))
+    k, cap = 2, T_LOCAL * 2  # ample capacity: nothing drops
+
+    mesh = Mesh(np.array(jax.devices()[:N_SHARDS]), ("expert",))
+    y_ep, aux_ep = _ep_fn(mesh, k, cap)(params, x)
+
+    # ground truth: the dense path on each token shard (routing is per-shard
+    # in EP, so capacity positions are assigned within each shard)
+    chunks, auxes = [], []
+    for i in range(N_SHARDS):
+        y, aux = moe_apply(params, x[i * T_LOCAL:(i + 1) * T_LOCAL], k=k,
+                           capacity=cap)
+        chunks.append(y)
+        auxes.append(aux)
+    np.testing.assert_allclose(np.asarray(y_ep),
+                               np.concatenate([np.asarray(c) for c in chunks]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_ep), float(np.mean(auxes)),
+                               rtol=1e-5)
+
+
+def test_ep_grads_match_dense():
+    key = jax.random.key(2)
+    params = moe_init(key, D_MODEL, D_HIDDEN, N_EXPERTS)
+    x = jax.random.normal(jax.random.key(3), (N_SHARDS * T_LOCAL, D_MODEL))
+    k, cap = 1, T_LOCAL  # top-1, still no drops
+    mesh = Mesh(np.array(jax.devices()[:N_SHARDS]), ("expert",))
+    ep = _ep_fn(mesh, k, cap)
+
+    def loss_ep(params, x):
+        y, _ = ep(params, x)
+        return jnp.mean(y ** 2)
+
+    def loss_dense(params, x):
+        ys = [moe_apply(params, x[i * T_LOCAL:(i + 1) * T_LOCAL], k=k,
+                        capacity=cap)[0] for i in range(N_SHARDS)]
+        return jnp.mean(jnp.concatenate(ys) ** 2)
+
+    g_ep = jax.grad(loss_ep, argnums=(0, 1))(params, x)
+    g_d = jax.grad(loss_dense, argnums=(0, 1))(params, x)
+    for a, b in zip(jax.tree.leaves(g_ep), jax.tree.leaves(g_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_capacity_overflow_drops_tokens():
+    """With every token forced onto expert 0 and capacity 1, exactly one token
+    per shard survives; dropped tokens produce zero output (residual path)."""
+    key = jax.random.key(4)
+    params = moe_init(key, D_MODEL, D_HIDDEN, N_EXPERTS)
+    # bias routing hard toward expert 0
+    router = np.zeros((D_MODEL, N_EXPERTS), np.float32)
+    router[:, 0] = 10.0
+    params = dict(params, router=jnp.asarray(router))
+    x = jnp.abs(jax.random.normal(jax.random.key(5), (6, D_MODEL))) + 0.1
+
+    y, _ = moe_apply(params, x, k=1, capacity=1)
+    norms = np.linalg.norm(np.asarray(y), axis=-1)
+    assert norms[0] > 0            # first token wins the single slot
+    np.testing.assert_allclose(norms[1:], 0.0, atol=1e-6)
+
+
+def test_dense_moe_trains():
+    """A dense-MoE regression head actually learns (loss decreases)."""
+    key = jax.random.key(6)
+    params = moe_init(key, D_MODEL, D_HIDDEN, 4)
+    w_true = 0.3 * jax.random.normal(jax.random.key(7), (D_MODEL, D_MODEL))
+    x = jax.random.normal(jax.random.key(8), (64, D_MODEL))
+    y_true = x @ w_true
+
+    @jax.jit
+    def step(params, lr=0.5):
+        def loss_fn(p):
+            y, aux = moe_apply(p, x, k=2)
+            return jnp.mean((x + y - y_true) ** 2) + 0.01 * aux
+        l, g = jax.value_and_grad(loss_fn)(params)
+        return jax.tree.map(lambda p, g: p - lr * g, params, g), l
+
+    params, l0 = step(params)
+    for _ in range(100):
+        params, l = step(params)
+    assert float(l) < 0.3 * float(l0)

@@ -88,3 +88,33 @@ def test_gpt_learns_markov_structure():
             first = float(loss)
     # uniform = ln(32) ~ 3.47; markov structure must be learnable well below
     assert float(loss) < first - 0.5, (first, float(loss))
+
+
+def test_moe_gpt_pipeline_trains():
+    """MoE-GPT (dense top-2 routed experts per block) through the 2-stage
+    pipeline: parity with fused, and loss decreases under SGD."""
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2,
+                    n_experts=4, moe_top_k=2)
+    key = jax.random.key(0)
+    stages, wire_dim, out_shape = make_gpt_stages(key, cfg, 2)
+    data = synthetic_tokens(16, cfg.seq_len, cfg.vocab, seed=1)
+    x = jnp.asarray(data.x, jnp.float32)
+    y = jnp.asarray(data.y)
+
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wire_dim, out_shape, n_microbatches=2)
+    buf = pipe.init_params()
+
+    loss, _ = pipe.loss_and_logits(buf, x, y, key, deterministic=True)
+    fused = fused_reference(stages)
+    want = nll_loss(fused([s.params for s in stages], x, key, True), y, "mean")
+    np.testing.assert_allclose(float(loss), float(want), rtol=2e-5, atol=2e-5)
+
+    opt = sgd(0.3, momentum=0.5)
+    opt_state = opt.init(buf)
+    step = make_train_step(pipe, opt)
+    l0 = None
+    for i in range(15):
+        buf, opt_state, l = step(buf, opt_state, x, y, jax.random.key(i))
+        l0 = float(l) if l0 is None else l0
+    assert float(l) < l0
