@@ -69,6 +69,13 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--seed', type=int, default=0)
     g.add_argument('--mlp-dims', type=str, default="784,512,10",
                    help="comma-separated layer widths for --model=mlp")
+    g.add_argument('--checkpoint-dir', type=str, default=None,
+                   help="write a checkpoint after every epoch and auto-resume "
+                        "from it on restart (the reference loses all progress "
+                        "on a crash)")
+    g.add_argument('--no-resume', action='store_true',
+                   help="with --checkpoint-dir: start fresh, ignore an "
+                        "existing checkpoint")
     g.add_argument('--experts', type=int, default=0,
                    help="for --model=gpt: replace each block's MLP with a "
                         "top-2-routed mixture of this many experts (0 = dense)")
@@ -152,7 +159,8 @@ def main(argv: list[str] | None = None) -> None:
                     n_microbatches=args.microbatches)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
-                         seed=args.seed)
+                         seed=args.seed, checkpoint_dir=args.checkpoint_dir,
+                         resume=not args.no_resume)
     Trainer(pipe, train_ds, test_ds, config).fit()
 
 
@@ -188,7 +196,8 @@ def _run_gpt(args, n_stages: int, key) -> None:
                     n_microbatches=args.microbatches)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
-                         seed=args.seed)
+                         seed=args.seed, checkpoint_dir=args.checkpoint_dir,
+                         resume=not args.no_resume)
     Trainer(pipe, train_ds, test_ds, config).fit()
 
 

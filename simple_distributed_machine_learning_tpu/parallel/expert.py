@@ -175,18 +175,3 @@ def moe_apply_ep(params: dict, x: jax.Array, k: int = 2,
                                 tiled=True)
     y = jnp.einsum("tec,ecd->td", combine, expert_out)
     return y, aux
-
-
-def shard_experts(params: dict, n_shards: int) -> list[dict]:
-    """Split a dense MoE param tree into per-device EP shards (router
-    replicated, experts partitioned contiguously)."""
-    E = n_experts_of(params)
-    if E % n_shards:
-        raise ValueError(f"{E} experts not divisible by {n_shards} shards")
-    per = E // n_shards
-    return [
-        {"router": params["router"],
-         "experts": jax.tree.map(lambda a, _i=i: a[_i * per:(_i + 1) * per],
-                                 params["experts"])}
-        for i in range(n_shards)
-    ]

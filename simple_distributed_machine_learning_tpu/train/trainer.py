@@ -49,6 +49,10 @@ class TrainConfig:
     log_interval: int = LOG_INTERVAL
     seed: int = 0
     print_throughput: bool = True
+    # persistence (absent from the reference, SURVEY §5.4): a checkpoint is
+    # written after every epoch and auto-resumed from on construction
+    checkpoint_dir: str | None = None
+    resume: bool = True
 
 
 class Trainer:
@@ -68,7 +72,62 @@ class Trainer:
         self._eval_step = make_eval_step(pipe)
         self._key = jax.random.key(self.config.seed)
         self._step_count = 0
+        self.start_epoch = 1
         self.is_main = jax.process_index() == 0
+        if self.config.checkpoint_dir and self.config.resume:
+            self._maybe_resume()
+
+    # -- persistence (reference has none: SURVEY §5.4) --------------------
+
+    def _ckpt_path(self) -> str:
+        import os
+        return os.path.join(self.config.checkpoint_dir, "state.npz")
+
+    def _maybe_resume(self) -> None:
+        import os
+        path = self._ckpt_path()
+        found = os.path.exists(path)
+        if jax.process_count() > 1:
+            # all processes must agree on whether/where to resume, or they
+            # would issue different numbers of collective steps and hang
+            # (e.g. checkpoint_dir on a non-shared filesystem)
+            from jax.experimental import multihost_utils
+            founds = multihost_utils.process_allgather(
+                np.asarray([1 if found else 0], np.int32))
+            if int(founds.min()) != int(founds.max()):
+                raise RuntimeError(
+                    f"checkpoint {path} visible on only some processes — "
+                    "checkpoint_dir must be a shared filesystem for "
+                    "multi-process resume")
+        if not found:
+            return
+        from simple_distributed_machine_learning_tpu.train.checkpoint import (
+            restore_checkpoint,
+        )
+        st = restore_checkpoint(path, pipe=self.pipe,
+                                opt_treedef_like=self.opt_state)
+        if tuple(st["params"].shape) != tuple(self.buf.shape):
+            raise ValueError(
+                f"checkpoint {path} does not match the model: packed param "
+                f"buffer is {tuple(st['params'].shape)}, model expects "
+                f"{tuple(self.buf.shape)} (different model/topology config?)")
+        self.buf, self.opt_state = st["params"], st["opt_state"]
+        self._step_count = st["step"]
+        self.start_epoch = int(st["extra"].get("epoch", 0)) + 1
+        self._print(f"| resumed from {path} at epoch {self.start_epoch} "
+                    f"(step {self._step_count})")
+
+    def _save(self, epoch: int) -> None:
+        if not self.config.checkpoint_dir:
+            return
+        from simple_distributed_machine_learning_tpu.train.checkpoint import (
+            save_checkpoint,
+        )
+        # gather-on-save assumes a fully-addressable (single-controller or
+        # single-host) mesh; multi-host saves go through process 0 only
+        if self.is_main:
+            save_checkpoint(self._ckpt_path(), self.buf, self.opt_state,
+                            self._step_count, extra={"epoch": epoch})
 
     # -- reference console surface (simple_distributed.py:114-117,:130-132) --
 
@@ -132,7 +191,9 @@ class Trainer:
         return avg, correct
 
     def fit(self) -> None:
-        """The reference's epoch driver (``simple_distributed.py:134-136``)."""
-        for epoch in range(1, self.config.epochs + 1):
+        """The reference's epoch driver (``simple_distributed.py:134-136``),
+        plus per-epoch checkpointing when ``checkpoint_dir`` is set."""
+        for epoch in range(self.start_epoch, self.config.epochs + 1):
             self.train_epoch(epoch)
             self.evaluate()
+            self._save(epoch)

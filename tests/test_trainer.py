@@ -59,3 +59,36 @@ def test_learns_synthetic_digits():
     avg_loss, correct = trainer.evaluate()
     assert correct / 100 > 0.5          # 10% is chance level
     assert avg_loss < 2.0
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    """fit() checkpoints every epoch; a new Trainer on the same dir resumes at
+    the next epoch with identical params and continues to the target epoch."""
+    train, test = synthetic_mnist(n_train=120, n_test=60, seed=4)
+    key = jax.random.key(0)
+
+    def build(epochs):
+        stages, wire_dim, out_dim = make_mlp_stages(
+            key, [784, 32, 10], 2)
+        ds_tr = Dataset(train.x.reshape(len(train.x), -1), train.y)
+        ds_te = Dataset(test.x.reshape(len(test.x), -1), test.y)
+        mesh = make_mesh(n_stages=2, n_data=1)
+        pipe = Pipeline(stages, mesh, wire_dim, out_dim)
+        cfg = TrainConfig(epochs=epochs, batch_size=60, print_throughput=False,
+                          checkpoint_dir=str(tmp_path))
+        return Trainer(pipe, ds_tr, ds_te, cfg)
+
+    t1 = build(epochs=2)
+    t1.fit()
+    steps_after_2 = t1._step_count
+
+    t2 = build(epochs=3)            # same dir: resumes after epoch 2
+    assert t2.start_epoch == 3
+    assert t2._step_count == steps_after_2
+    np.testing.assert_array_equal(np.asarray(jax.device_get(t2.buf)),
+                                  np.asarray(jax.device_get(t1.buf)))
+    t2.fit()                        # runs exactly epoch 3
+    assert t2._step_count > steps_after_2
+
+    t3 = build(epochs=3)
+    assert t3.start_epoch == 4      # nothing left to do
