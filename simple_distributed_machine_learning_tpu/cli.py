@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--dp', type=int, default=1,
                    help="data-parallel mesh width (batch must divide by "
                         "dp * microbatches)")
+    g.add_argument('--tp', type=int, default=1,
+                   help="tensor-parallel width for --model=mlp: each stage "
+                        "becomes a column->row sharded pair (needs exactly "
+                        "2*stages layers in --mlp-dims, hidden widths "
+                        "divisible by tp)")
     g.add_argument('--epochs', type=int, default=10)
     g.add_argument('--batch-size', type=int, default=60)
     g.add_argument('--lr', type=float, default=0.1)
@@ -122,6 +127,8 @@ def main(argv: list[str] | None = None) -> None:
     n_stages = args.stages if args.stages is not None else (2 if n_dev >= 2 else 1)
 
     key = jax.random.key(args.seed)
+    if args.tp > 1 and args.model != "mlp":
+        raise SystemExit("--tp is only supported with --model=mlp")
     if args.model == "gpt":
         _run_gpt(args, n_stages, key)
         return
@@ -131,6 +138,14 @@ def main(argv: list[str] | None = None) -> None:
         )
         stages, wire_dim, out_dim = make_lenet_stages(key, n_stages)
         in_is_image = True
+    elif args.tp > 1:
+        from simple_distributed_machine_learning_tpu.parallel.tensor import (
+            make_mlp_tp_stages,
+        )
+        dims = [int(d) for d in args.mlp_dims.split(",")]
+        stages, wire_dim, out_dim = make_mlp_tp_stages(key, dims, n_stages,
+                                                       args.tp)
+        in_is_image = False
     else:
         from simple_distributed_machine_learning_tpu.models.mlp import (
             make_mlp_stages,
@@ -154,7 +169,7 @@ def main(argv: list[str] | None = None) -> None:
         Trainer,
     )
 
-    mesh = make_mesh(n_stages=n_stages, n_data=args.dp)
+    mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_model=args.tp)
     pipe = Pipeline(stages, mesh, wire_dim, out_dim,
                     n_microbatches=args.microbatches)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,

@@ -8,9 +8,13 @@ Replaces the reference's process bootstrap — argparse → env exports →
 - ``"data"``  — data parallelism (batch sharding; grads all-reduced over ICI)
 - ``"stage"`` — pipeline parallelism (one pipeline stage per mesh slot;
   activations hop stage→stage+1 via ``lax.ppermute``)
+- ``"model"`` — tensor (Megatron-style) parallelism within a stage (hidden
+  dim sharded; one ``lax.psum`` per sharded pair — see ``tensor.py``)
 
-Axis order is (data, stage) so that neighbouring pipeline stages are adjacent
-device ids — on a real slice that keeps the stage hop on the shortest ICI path.
+Axis order is (data, stage, model), model fastest-varying: tensor-parallel
+psums are the chattiest collective so their group gets adjacent device ids;
+pipeline neighbours come next; data-parallel gradient all-reduce — once per
+step — tolerates the longest paths.
 """
 
 from __future__ import annotations
@@ -24,32 +28,37 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 STAGE_AXIS = "stage"
+MODEL_AXIS = "model"
 
 
 def make_mesh(n_stages: int = 1, n_data: int | None = None,
+              n_model: int = 1,
               devices: Sequence[jax.Device] | None = None) -> Mesh:
-    """Build a ``(data, stage)`` mesh from the available devices.
+    """Build a ``(data, stage, model)`` mesh from the available devices.
 
-    ``n_data`` defaults to ``len(devices) // n_stages`` so the whole slice is
-    used. The reference's topology was fixed at exactly 2 ranks with the peer
-    name hardcoded (``simple_distributed.py:34``); here the topology is derived
-    from the device list.
+    ``n_data`` defaults to ``len(devices) // (n_stages * n_model)`` so the
+    whole slice is used. The reference's topology was fixed at exactly 2 ranks
+    with the peer name hardcoded (``simple_distributed.py:34``); here the
+    topology is derived from the device list.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
-    if n_stages < 1:
-        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if n_stages < 1 or n_model < 1:
+        raise ValueError(
+            f"n_stages/n_model must be >= 1, got {n_stages}/{n_model}")
     if n_data is None:
-        if len(devices) % n_stages != 0:
+        if len(devices) % (n_stages * n_model) != 0:
             raise ValueError(
                 f"{len(devices)} devices not divisible into {n_stages} "
-                f"pipeline stages (pass n_data to use a subset)")
-        n_data = len(devices) // n_stages
-    if n_data * n_stages > len(devices):
+                f"pipeline stages x {n_model} model shards (pass n_data to "
+                f"use a subset)")
+        n_data = len(devices) // (n_stages * n_model)
+    need = n_data * n_stages * n_model
+    if need > len(devices):
         raise ValueError(
-            f"mesh {n_data}x{n_stages} needs {n_data * n_stages} devices, "
+            f"mesh {n_data}x{n_stages}x{n_model} needs {need} devices, "
             f"have {len(devices)}")
-    grid = np.array(devices[: n_data * n_stages]).reshape(n_data, n_stages)
-    return Mesh(grid, (DATA_AXIS, STAGE_AXIS))
+    grid = np.array(devices[:need]).reshape(n_data, n_stages, n_model)
+    return Mesh(grid, (DATA_AXIS, STAGE_AXIS, MODEL_AXIS))
 
 
 def bootstrap_distributed(rank: int, world_size: int, master_addr: str,

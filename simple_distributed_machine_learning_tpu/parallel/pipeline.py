@@ -37,7 +37,11 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
-from simple_distributed_machine_learning_tpu.parallel.mesh import DATA_AXIS, STAGE_AXIS
+from simple_distributed_machine_learning_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+)
 from simple_distributed_machine_learning_tpu.parallel.staging import (
     StageMeta,
     pack_stage_params,
@@ -56,10 +60,20 @@ class Stage:
     features are re-encoded onto the wire by the engine. The last stage must
     return log-probabilities ``[batch, out_dim]`` (the reference's stage 1
     ends in ``log_softmax``, ``simple_distributed.py:79``).
+
+    ``shards``: optional per-model-shard params for tensor parallelism — a
+    tuple of ``n_model`` pytrees (identical tree structure and leaf shapes).
+    When set, ``apply`` receives THIS device's shard and may use collectives
+    over the ``model`` mesh axis (e.g. ``tensor.tp_pair_apply``); every model
+    shard must return the same (replicated) activation, i.e. finish each
+    sharded group with its psum. When ``shards`` is None on a mesh with
+    ``n_model > 1``, ``params`` is replicated to every model slot and the
+    stage computes redundantly (correct, just not sharded).
     """
     apply: Callable[[Any, jax.Array, jax.Array, bool], jax.Array]
     params: Any
     in_shape: tuple[int, ...]
+    shards: tuple | None = None
 
 
 class Pipeline:
@@ -79,6 +93,7 @@ class Pipeline:
         self.mesh = mesh
         self.n_stages = mesh.shape[STAGE_AXIS]
         self.n_data = mesh.shape[DATA_AXIS]
+        self.n_model = mesh.shape.get(MODEL_AXIS, 1)
         if len(self.stages) != self.n_stages:
             raise ValueError(
                 f"{len(self.stages)} stages but mesh stage axis is {self.n_stages}")
@@ -90,7 +105,38 @@ class Pipeline:
         self.out_dim = self.out_shape[-1]
         self.n_microbatches = int(n_microbatches)
         self._sm_cache: dict[bool, Callable] = {}
-        self._buf0, self.metas = pack_stage_params([s.params for s in self.stages])
+        # param buffer rows: one per (stage, model-shard). Stages without
+        # shards are replicated across the model axis (redundant compute,
+        # identical grads — the data-axis story, one level down).
+        per_shard: list[Any] = []
+        for s in self.stages:
+            if s.shards is not None:
+                if len(s.shards) != self.n_model:
+                    raise ValueError(
+                        f"stage has {len(s.shards)} model shards, mesh model "
+                        f"axis is {self.n_model}")
+                per_shard.extend(s.shards)
+            else:
+                per_shard.extend([s.params] * self.n_model)
+        flat, metas_all = pack_stage_params(per_shard)
+        import numpy as np
+        # keep the master copy on the HOST: device_put of an on-device array
+        # with a matching sharding ALIASES it, and a later donated train step
+        # would delete the alias — init_params() must survive any number of
+        # donating steps
+        self._buf0 = np.asarray(
+            jax.device_get(flat.reshape(self.n_stages, self.n_model, -1)))
+        # shard 0's layout stands for the stage (shards are shape-identical)
+        self.metas = metas_all[:: self.n_model]
+        for s, stage in enumerate(self.stages):
+            if stage.shards is not None:
+                m0 = metas_all[s * self.n_model]
+                for m in metas_all[s * self.n_model:(s + 1) * self.n_model]:
+                    if m.shapes != m0.shapes:
+                        raise ValueError(
+                            f"stage {s}: model shards have differing leaf "
+                            f"shapes — tensor-parallel shards must split "
+                            f"evenly")
         self._validate_boundaries()
 
     def _validate_boundaries(self) -> None:
@@ -103,6 +149,11 @@ class Pipeline:
         import numpy as np
         batch = 2
         for s, stage in enumerate(self.stages):
+            if stage.shards is not None:
+                # tensor-parallel applies use mesh collectives, which have no
+                # meaning under eval_shape outside shard_map — the first real
+                # trace still shape-checks them, just with a deeper trace
+                continue
             x = jax.ShapeDtypeStruct((batch,) + tuple(stage.in_shape), jnp.float32)
             key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
             out = jax.eval_shape(
@@ -131,16 +182,26 @@ class Pipeline:
 
     # ---- parameters -----------------------------------------------------
 
+    def param_spec(self) -> P:
+        """PartitionSpec of the packed ``[n_stages, n_model, P]`` buffer."""
+        return P(STAGE_AXIS, MODEL_AXIS, None)
+
     def init_params(self) -> jax.Array:
-        """Place the packed stage-param buffer on the mesh (stage-sharded)."""
-        sharding = NamedSharding(self.mesh, P(STAGE_AXIS, None))
+        """Place the packed stage-param buffer on the mesh (stage- and
+        model-shard-sharded; replicated over the data axis)."""
+        sharding = NamedSharding(self.mesh, self.param_spec())
         return jax.device_put(self._buf0, sharding)
 
     def unpack(self, buf: jax.Array) -> list[Any]:
-        """Host-side: recover the per-stage param pytrees (for tests/ckpt)."""
+        """Host-side: recover the per-stage param pytrees (for tests/ckpt).
+        For model-sharded stages the entry is the list of per-shard trees."""
         rows = jax.device_get(buf)
-        return [unpack_stage_params(jnp.asarray(rows[s]), self.metas[s])
-                for s in range(self.n_stages)]
+        out = []
+        for s in range(self.n_stages):
+            trees = [unpack_stage_params(jnp.asarray(rows[s, m]), self.metas[s])
+                     for m in range(self.n_model)]
+            out.append(trees if self.stages[s].shards is not None else trees[0])
+        return out
 
     # ---- forward/loss ---------------------------------------------------
 
@@ -157,17 +218,28 @@ class Pipeline:
         metas = list(self.metas)
         applies = [s.apply for s in self.stages]
         in_shapes = [s.in_shape for s in self.stages]
+        n_model = self.n_model
+        # stages without model shards compute redundantly on every model slot;
+        # their params need the grad_sync treatment (see tensor.grad_sync) so
+        # each replica receives the full, not 1/n_model, gradient
+        replicated_over_model = [s.shards is None for s in self.stages]
 
-        def per_device(row2d, x_mb, tgt_mb, w_mb, key):
-            # row2d: [1, P] local param row; x_mb: [M, mb, wire];
-            # tgt_mb/w_mb: [M, mb] targets and per-sample loss weights
-            row = row2d[0]
+        def per_device(row3d, x_mb, tgt_mb, w_mb, key):
+            # row3d: [1, 1, P] this device's (stage, model-shard) param row;
+            # x_mb: [M, mb, wire]; tgt_mb/w_mb: [M, mb] targets and weights
+            row = row3d[0, 0]
             stage = lax.axis_index(STAGE_AXIS)
             mb = x_mb.shape[1]
 
             def make_branch(s):
                 def branch(wire, k):
                     params = unpack_stage_params(row, metas[s])
+                    if n_model > 1 and replicated_over_model[s]:
+                        from simple_distributed_machine_learning_tpu.parallel.tensor import (
+                            grad_sync,
+                        )
+                        params = jax.tree.map(
+                            lambda a: grad_sync(a, MODEL_AXIS), params)
                     x = wire_decode(wire, in_shapes[s])
                     y = applies[s](params, x, k, deterministic)
                     return wire_encode(y, wire_dim)
@@ -229,7 +301,10 @@ class Pipeline:
         fn = jax.shard_map(
             per_device,
             mesh=self.mesh,
-            in_specs=(P(STAGE_AXIS, None), P(None, DATA_AXIS, None),
+            # activations/targets are replicated over the model axis (left
+            # unmentioned); TP stages shard their compute internally and
+            # restore replication with their own psums
+            in_specs=(P(STAGE_AXIS, MODEL_AXIS, None), P(None, DATA_AXIS, None),
                       P(None, DATA_AXIS), P(None, DATA_AXIS), P()),
             out_specs=(P(), P(None, DATA_AXIS)),
             check_vma=False,
@@ -256,6 +331,14 @@ class Pipeline:
         if B % (M * self.n_data) != 0:
             raise ValueError(
                 f"batch {B} not divisible by microbatches*data = {M * self.n_data}")
+        if (self.n_stages == 1 and self.n_data == 1 and self.n_model == 1
+                and self.stages[0].shards is None):
+            # degenerate mesh: the pipeline IS the fused model. Skip the
+            # shard_map engine — its packed-row unpack/repack costs ~10x the
+            # model itself at this scale (grad of the slice/concat machinery),
+            # with nothing to overlap on one device.
+            return self._fused_loss(buf, x, targets, key, deterministic,
+                                    weights)
         # the wire is always float32 (stages decode/cast as needed — e.g. the
         # GPT embedding stage reads token ids back out of the float wire)
         xw = wire_encode(x, self.wire_dim).astype(jnp.float32).reshape(
@@ -265,6 +348,30 @@ class Pipeline:
              else weights.astype(jnp.float32)).reshape(M, B // M)
         loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, w, key)
         return loss, logits.reshape((B,) + self.out_shape)
+
+    def _fused_loss(self, buf, x, targets, key, deterministic, weights):
+        """Single-device fast path. Identical to the engine for
+        ``n_microbatches == 1`` or deterministic mode (same RNG stream: the
+        engine's stage-0 key at step 0 on data shard 0); with several
+        microbatches AND dropout the engine draws per-microbatch noise while
+        this path draws one batch-wide key — same distribution, different
+        stream."""
+        import jax.numpy as jnp
+
+        B = x.shape[0]
+        stage = self.stages[0]
+        params = unpack_stage_params(buf[0, 0], self.metas[0])
+        k = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(key, 0), 0), 0)
+        logp = stage.apply(params, x.reshape((B,) + tuple(stage.in_shape)),
+                           k, deterministic)
+        nll = nll_loss(logp, targets, "none")
+        w = (jnp.ones((B,), jnp.float32) if weights is None
+             else weights.astype(jnp.float32))
+        wb = jnp.broadcast_to(
+            w.reshape(w.shape + (1,) * (nll.ndim - 1)), nll.shape)
+        loss = jnp.sum(nll * wb) / jnp.maximum(jnp.sum(wb), 1e-12)
+        return loss, logp
 
 
 def fused_reference(stages: Sequence[Stage]) -> Callable:

@@ -16,13 +16,14 @@ functions to be called inside ``shard_map`` (composable with the pipeline's
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from simple_distributed_machine_learning_tpu.ops.layers import linear_init
-
-MODEL_AXIS = "model"
+from simple_distributed_machine_learning_tpu.parallel.mesh import MODEL_AXIS
 
 
 def tp_pair_init(key: jax.Array, d_in: int, d_hidden: int, d_out: int,
@@ -45,23 +46,97 @@ def tp_pair_init(key: jax.Array, d_in: int, d_hidden: int, d_out: int,
         shards.append({
             "w1": {"w": w1["w"][:, i * h:(i + 1) * h],
                    "b": w1["b"][i * h:(i + 1) * h]},
-            "w2": {"w": w2["w"][i * h:(i + 1) * h, :],
-                   # bias added once, on shard 0 only (it is not sharded)
-                   "b": w2["b"] if i == 0 else jnp.zeros_like(w2["b"])},
+            # w2's bias is REPLICATED on every shard and added after the
+            # psum: each replica then receives the identical cotangent, so
+            # SPMD updates keep the copies in sync and the effective bias
+            # trains at exactly the dense rate (a shard-0-only bias added
+            # pre-psum would train n_shards times too fast)
+            "w2": {"w": w2["w"][i * h:(i + 1) * h, :], "b": w2["b"]},
         })
     return shards
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def grad_sync(x: jax.Array, axis: str) -> jax.Array:
+    """Identity forward; psum over ``axis`` backward.
+
+    For params that are REPLICATED over a mesh axis inside ``shard_map`` but
+    carried in per-device (axis-sharded) storage: when the loss is built from
+    axis-replicated values, the transpose machinery splits the loss cotangent
+    evenly across the axis (each replica sees 1/axis_size of it). Leaves whose
+    forward path crosses a psum recover the full cotangent through the psum's
+    transpose; leaves that stay replicated (e.g. a row-parallel pair's output
+    bias, or a whole non-tensor-parallel stage on a model>1 mesh) do not —
+    their grads come out 1/axis_size of the true value, and replicas would
+    train too slowly. Wrapping such params in ``grad_sync`` restores the full
+    gradient on every replica (and keeps replicas bit-identical, since each
+    gets the same psum).
+    """
+    return x
+
+
+def _grad_sync_fwd(x, axis):
+    return x, None
+
+
+def _grad_sync_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+grad_sync.defvjp(_grad_sync_fwd, _grad_sync_bwd)
 
 
 def tp_pair_apply(params: dict, x: jax.Array, activation=jax.nn.relu,
                   axis: str = MODEL_AXIS) -> jax.Array:
     """Column→activation→row parallel pair. Call inside shard_map; ``params``
-    is THIS device's shard. One psum over ``axis`` per call."""
+    is THIS device's shard. One psum over ``axis`` per call; the output bias
+    is replicated and added after the reduce (see :func:`tp_pair_init`), with
+    :func:`grad_sync` restoring its full (unsplit) gradient."""
     h = activation(x @ params["w1"]["w"] + params["w1"]["b"])
-    partial_out = h @ params["w2"]["w"] + params["w2"]["b"]
-    return lax.psum(partial_out, axis)
+    return lax.psum(h @ params["w2"]["w"], axis) + grad_sync(
+        params["w2"]["b"], axis)
 
 
 def stack_tp_shards(shards: list[dict]):
     """Stack per-shard pytrees along a leading axis for ``P('model')``
     placement: leaf i of the result has shape ``[n_shards, ...]``."""
     return jax.tree.map(lambda *ls: jnp.stack(ls), *shards)
+
+
+def make_mlp_tp_stages(key: jax.Array, dims, n_stages: int, n_model: int):
+    """Tensor-parallel MLP pipeline stages: dp x pp x tp in one step.
+
+    Like :func:`~..models.mlp.make_mlp_stages` but each stage is a
+    column→row parallel linear *pair* sharded ``n_model`` ways over the
+    ``model`` mesh axis, so ``dims`` must have ``2 * n_stages`` layers
+    (length ``2 * n_stages + 1``) and every hidden width must divide by
+    ``n_model``. Initialization splits the same dense init as the unsharded
+    layers, so the TP pipeline matches a dense single-device run to float
+    tolerance (tests/test_tp_pipeline.py).
+
+    Returns ``(stages, wire_dim, out_dim)`` for :class:`~.pipeline.Pipeline`
+    on a ``make_mesh(n_stages=..., n_model=...)`` mesh.
+    """
+    from simple_distributed_machine_learning_tpu.ops.losses import log_softmax
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import Stage
+
+    dims = [int(d) for d in dims]
+    if len(dims) != 2 * n_stages + 1:
+        raise ValueError(
+            f"TP stages hold one column->row pair each: need exactly "
+            f"{2 * n_stages} layers for {n_stages} stages, got {len(dims) - 1}")
+    keys = jax.random.split(key, n_stages)
+
+    stages = []
+    for s in range(n_stages):
+        d_in, d_h, d_out = dims[2 * s], dims[2 * s + 1], dims[2 * s + 2]
+        shards = tuple(tp_pair_init(keys[s], d_in, d_h, d_out, n_model))
+        is_last = s == n_stages - 1
+
+        def apply(params, x, key, deterministic, _last=is_last):
+            y = tp_pair_apply(params, x, activation=jax.nn.relu)
+            return log_softmax(y) if _last else jax.nn.relu(y)
+
+        stages.append(Stage(apply=apply, params=shards[0],
+                            in_shape=(d_in,), shards=shards))
+    return stages, max(dims), dims[-1]

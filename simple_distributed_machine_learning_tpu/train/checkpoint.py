@@ -57,13 +57,10 @@ def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None
 
     buf = params
     if pipe is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import NamedSharding
 
-        from simple_distributed_machine_learning_tpu.parallel.mesh import (
-            STAGE_AXIS,
-        )
         buf = jax.device_put(
-            params, NamedSharding(pipe.mesh, P(STAGE_AXIS, None)))
+            params, NamedSharding(pipe.mesh, pipe.param_spec()))
 
     opt_state: Any = opt_leaves
     if opt_treedef_like is not None:

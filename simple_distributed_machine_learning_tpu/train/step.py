@@ -53,8 +53,67 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
     TPU-idiomatic shape of a training loop, and what ``bench.py`` measures.
     """
 
+    from simple_distributed_machine_learning_tpu.parallel.staging import (
+        pack_stage_params,
+        unpack_stage_params,
+    )
+
+    # shards-is-None matters: a tensor-parallel stage's apply uses mesh
+    # collectives, which cannot be traced outside shard_map
+    trivial_mesh = (pipe.n_stages == 1 and pipe.n_data == 1
+                    and pipe.n_model == 1 and pipe.stages[0].shards is None)
+
+    from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(buf, opt_state, xs, targets, key):
+        # On the degenerate single-device mesh, differentiating through the
+        # packed [1, 1, P] buffer costs ~10x the model itself per scan
+        # iteration (the slice/concat machinery's autodiff). Unpack params and
+        # any buffer-shaped optimizer state to pytrees ONCE per window, scan
+        # on pytrees, repack at the end. Requires elementwise (buffer-shaped)
+        # opt state — true for the built-in SGD; anything else falls through
+        # to the generic path.
+        os_leaves, os_def = jax.tree.flatten(opt_state)
+        unpackable = trivial_mesh and all(
+            getattr(l, "shape", None) == buf.shape for l in os_leaves)
+
+        if unpackable:
+            meta = pipe.metas[0]
+            stage = pipe.stages[0]
+
+            def repack(tree):
+                return pack_stage_params([tree])[0].reshape(buf.shape)
+
+            params0 = unpack_stage_params(buf[0, 0], meta)
+            state0 = jax.tree.unflatten(os_def, [
+                unpack_stage_params(l[0, 0], meta) for l in os_leaves])
+
+            def loss_tree(pp, x, t, k):
+                # same math and RNG stream as Pipeline._fused_loss
+                kk = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(k, 0), 0), 0)
+                logp = stage.apply(
+                    pp, x.reshape((x.shape[0],) + tuple(stage.in_shape)),
+                    kk, False)
+                return nll_loss(logp, t, "mean")
+
+            def body(carry, batch):
+                p, s, i = carry
+                x, t = batch
+                k = jax.random.fold_in(key, i)
+                loss, grads = jax.value_and_grad(loss_tree)(p, x, t, k)
+                p2, s2 = opt.update(grads, s, p)
+                return (p2, s2, i + 1), loss
+
+            (p2, s2, _), losses = jax.lax.scan(
+                body, (params0, state0, 0), (xs, targets), unroll=unroll)
+            # s2's "leaves" (per packed-state slot) are params-shaped trees;
+            # flatten_up_to recovers them for repacking
+            opt2 = jax.tree.unflatten(
+                os_def, [repack(t_) for t_ in os_def.flatten_up_to(s2)])
+            return repack(p2), opt2, losses
+
         def body(carry, batch):
             b, s, i = carry
             x, t = batch
