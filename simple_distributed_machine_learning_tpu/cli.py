@@ -84,6 +84,15 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--experts', type=int, default=0,
                    help="for --model=gpt: replace each block's MLP with a "
                         "top-2-routed mixture of this many experts (0 = dense)")
+    g.add_argument('--bf16', action='store_true',
+                   help="bfloat16 compute (float32 master params and loss): "
+                        "doubles MXU throughput, halves HBM traffic")
+    g.add_argument('--remat', action='store_true',
+                   help="rematerialize stage activations in backward "
+                        "(jax.checkpoint): trades FLOPs for memory")
+    g.add_argument('--profile', type=str, default=None, metavar='DIR',
+                   help="capture an XProf/TensorBoard trace of the whole run "
+                        "into DIR")
     return p
 
 
@@ -171,12 +180,29 @@ def main(argv: list[str] | None = None) -> None:
 
     mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_model=args.tp)
     pipe = Pipeline(stages, mesh, wire_dim, out_dim,
-                    n_microbatches=args.microbatches)
+                    n_microbatches=args.microbatches,
+                    compute_dtype=_compute_dtype(args), remat=args.remat)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
                          resume=not args.no_resume)
-    Trainer(pipe, train_ds, test_ds, config).fit()
+    _fit(args, Trainer(pipe, train_ds, test_ds, config))
+
+
+def _compute_dtype(args):
+    if not args.bf16:
+        return None
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+def _fit(args, trainer) -> None:
+    if args.profile:
+        from simple_distributed_machine_learning_tpu.utils.profiler import trace
+        with trace(args.profile):
+            trainer.fit()
+    else:
+        trainer.fit()
 
 
 def _run_gpt(args, n_stages: int, key) -> None:
@@ -208,12 +234,13 @@ def _run_gpt(args, n_stages: int, key) -> None:
 
     mesh = make_mesh(n_stages=n_stages, n_data=args.dp)
     pipe = Pipeline(stages, mesh, wire_dim, out_shape,
-                    n_microbatches=args.microbatches)
+                    n_microbatches=args.microbatches,
+                    compute_dtype=_compute_dtype(args), remat=args.remat)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
                          resume=not args.no_resume)
-    Trainer(pipe, train_ds, test_ds, config).fit()
+    _fit(args, Trainer(pipe, train_ds, test_ds, config))
 
 
 if __name__ == "__main__":

@@ -88,7 +88,8 @@ class Pipeline:
 
     def __init__(self, stages: Sequence[Stage], mesh: jax.sharding.Mesh,
                  wire_dim: int, out_dim: int | tuple[int, ...],
-                 n_microbatches: int = 1):
+                 n_microbatches: int = 1, compute_dtype=None,
+                 remat: bool = False):
         self.stages = list(stages)
         self.mesh = mesh
         self.n_stages = mesh.shape[STAGE_AXIS]
@@ -104,6 +105,13 @@ class Pipeline:
                           else tuple(int(d) for d in out_dim))
         self.out_dim = self.out_shape[-1]
         self.n_microbatches = int(n_microbatches)
+        # mixed precision: params and activations are cast to compute_dtype
+        # around each stage apply (bfloat16 doubles MXU throughput and halves
+        # HBM traffic); master params, the wire, and the loss stay float32.
+        # remat: stage applies recompute in backward (jax.checkpoint), trading
+        # FLOPs for activation memory — the standard deep-pipeline trade.
+        self.compute_dtype = compute_dtype
+        self.remat = bool(remat)
         self._sm_cache: dict[bool, Callable] = {}
         # param buffer rows: one per (stage, model-shard). Stages without
         # shards are replicated across the model axis (redundant compute,
@@ -223,6 +231,8 @@ class Pipeline:
         # their params need the grad_sync treatment (see tensor.grad_sync) so
         # each replica receives the full, not 1/n_model, gradient
         replicated_over_model = [s.shards is None for s in self.stages]
+        compute_dtype = self.compute_dtype
+        remat = self.remat
 
         def per_device(row3d, x_mb, tgt_mb, w_mb, key):
             # row3d: [1, 1, P] this device's (stage, model-shard) param row;
@@ -241,8 +251,14 @@ class Pipeline:
                         params = jax.tree.map(
                             lambda a: grad_sync(a, MODEL_AXIS), params)
                     x = wire_decode(wire, in_shapes[s])
+                    if compute_dtype is not None:
+                        params = jax.tree.map(
+                            lambda a: a.astype(compute_dtype), params)
+                        x = x.astype(compute_dtype)
                     y = applies[s](params, x, k, deterministic)
-                    return wire_encode(y, wire_dim)
+                    return wire_encode(y.astype(jnp.float32), wire_dim)
+                if remat:
+                    return jax.checkpoint(branch)
                 return branch
 
             branches = [make_branch(s) for s in range(S)]
@@ -361,10 +377,14 @@ class Pipeline:
         B = x.shape[0]
         stage = self.stages[0]
         params = unpack_stage_params(buf[0, 0], self.metas[0])
+        xs = x.reshape((B,) + tuple(stage.in_shape))
+        if self.compute_dtype is not None:
+            params = jax.tree.map(
+                lambda a: a.astype(self.compute_dtype), params)
+            xs = xs.astype(self.compute_dtype)
         k = jax.random.fold_in(
             jax.random.fold_in(jax.random.fold_in(key, 0), 0), 0)
-        logp = stage.apply(params, x.reshape((B,) + tuple(stage.in_shape)),
-                           k, deterministic)
+        logp = stage.apply(params, xs, k, deterministic).astype(jnp.float32)
         nll = nll_loss(logp, targets, "none")
         w = (jnp.ones((B,), jnp.float32) if weights is None
              else weights.astype(jnp.float32))

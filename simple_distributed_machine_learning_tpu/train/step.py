@@ -93,10 +93,14 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
                 # same math and RNG stream as Pipeline._fused_loss
                 kk = jax.random.fold_in(
                     jax.random.fold_in(jax.random.fold_in(k, 0), 0), 0)
-                logp = stage.apply(
-                    pp, x.reshape((x.shape[0],) + tuple(stage.in_shape)),
-                    kk, False)
-                return nll_loss(logp, t, "mean")
+                xs = x.reshape((x.shape[0],) + tuple(stage.in_shape))
+                if pipe.compute_dtype is not None:
+                    pp = jax.tree.map(
+                        lambda a: a.astype(pipe.compute_dtype), pp)
+                    xs = xs.astype(pipe.compute_dtype)
+                logp = stage.apply(pp, xs, kk, False)
+                import jax.numpy as jnp
+                return nll_loss(logp.astype(jnp.float32), t, "mean")
 
             def body(carry, batch):
                 p, s, i = carry
