@@ -84,6 +84,19 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--experts', type=int, default=0,
                    help="for --model=gpt: replace each block's MLP with a "
                         "top-2-routed mixture of this many experts (0 = dense)")
+    g.add_argument('--sp', type=int, default=1,
+                   help="sequence-parallel width for --model=gpt: shards the "
+                        "token axis over a 'seq' mesh axis (requires "
+                        "--attn ring or ulysses)")
+    g.add_argument('--ep', type=int, default=1,
+                   help="expert-parallel width for --model=gpt with "
+                        "--experts: shards expert weights over an 'expert' "
+                        "mesh axis with all-to-all dispatch")
+    g.add_argument('--attn', choices=("dense", "flash", "ring", "ulysses"),
+                   default="dense",
+                   help="attention implementation for --model=gpt (flash = "
+                        "Pallas fused kernel; ring/ulysses = sequence-"
+                        "parallel collectives, used with --sp)")
     g.add_argument('--bf16', action='store_true',
                    help="bfloat16 compute (float32 master params and loss): "
                         "doubles MXU throughput, halves HBM traffic")
@@ -138,6 +151,10 @@ def main(argv: list[str] | None = None) -> None:
     key = jax.random.key(args.seed)
     if args.tp > 1 and args.model != "mlp":
         raise SystemExit("--tp is only supported with --model=mlp")
+    if args.sp > 1 and args.model != "gpt":
+        raise SystemExit("--sp is only supported with --model=gpt")
+    if args.ep > 1 and (args.model != "gpt" or args.experts < 1):
+        raise SystemExit("--ep needs --model=gpt with --experts > 0")
     if args.model == "gpt":
         _run_gpt(args, n_stages, key)
         return
@@ -224,7 +241,9 @@ def _run_gpt(args, n_stages: int, key) -> None:
     )
 
     cfg = GPTConfig(n_experts=args.experts,
-                    moe_top_k=min(2, max(1, args.experts)))
+                    moe_top_k=min(2, max(1, args.experts)),
+                    attn_impl=args.attn, n_seq=args.sp,
+                    n_expert_parallel=args.ep)
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
     # one Markov chain, disjoint train/test sequences (a different seed would
     # regenerate a different transition matrix — nothing would transfer)
@@ -232,7 +251,8 @@ def _run_gpt(args, n_stages: int, key) -> None:
     train_ds = Dataset(all_data.x[:6000].astype(np.float32), all_data.y[:6000])
     test_ds = Dataset(all_data.x[6000:].astype(np.float32), all_data.y[6000:])
 
-    mesh = make_mesh(n_stages=n_stages, n_data=args.dp)
+    mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_seq=args.sp,
+                     n_expert=args.ep)
     pipe = Pipeline(stages, mesh, wire_dim, out_shape,
                     n_microbatches=args.microbatches,
                     compute_dtype=_compute_dtype(args), remat=args.remat)

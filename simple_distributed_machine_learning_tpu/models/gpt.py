@@ -49,25 +49,66 @@ class GPTConfig:
     n_layers: int = 2
     mlp_ratio: int = 4
     dropout_rate: float = 0.0   # tiny-GPT default: no dropout
-    attn_impl: str = "dense"    # "dense" | "flash" (Pallas fused kernel)
+    # attention implementation:
+    #   "dense"   — plain causal MHA (single-device math)
+    #   "flash"   — Pallas fused kernel (ops/flash_attention.py)
+    #   "ring"    — ring attention over the mesh's seq axis: K/V blocks
+    #               rotate via ppermute (ops/attention.py); requires n_seq > 1
+    #               to actually shard (falls back to dense math at n_seq=1)
+    #   "ulysses" — DeepSpeed-Ulysses all-to-all head/sequence re-sharding
+    #               (parallel/sequence.py); n_heads must divide by n_seq
+    attn_impl: str = "dense"
+    # sequence parallelism: n_seq > 1 shards the token axis over the mesh's
+    # "seq" axis — stage in_shapes, the wire, and all block compute are then
+    # per-shard (seq_len / n_seq tokens); cross-token mixing happens only in
+    # the attention collective chosen above.
+    n_seq: int = 1
     # MoE: n_experts > 0 replaces each block's MLP with a mixture-of-experts
-    # FFN (top-k routed, see parallel/expert.py). Inside the pipeline the MoE
-    # runs dense per stage with a generous capacity (the router's Switch aux
-    # loss is exposed via expert.moe_apply for standalone use; the pipeline's
-    # NLL-only loss path does not add it — acceptable at tiny expert counts).
+    # FFN (top-k routed, see parallel/expert.py). The Switch load-balancing
+    # aux loss (scaled by moe_aux_weight) is returned alongside the stage
+    # output and threaded into the pipeline objective by the engine.
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
+    # expert parallelism: n_expert_parallel > 1 shards each block's expert
+    # weights over the mesh's "expert" axis (E / n_ep experts per device) and
+    # splits the microbatch's sequences across it — each device routes its
+    # own sequences, the 2x all-to-all inside moe_apply_ep ships capacity
+    # buffers to the expert owners, and an all_gather reassembles the batch.
+    # Routing groups (one sequence each) are identical to the dense path, so
+    # EP is numerically exact vs n_expert_parallel=1.
+    n_expert_parallel: int = 1
 
     def __post_init__(self):
-        if self.attn_impl not in ("dense", "flash"):
+        if self.attn_impl not in ("dense", "flash", "ring", "ulysses"):
             raise ValueError(
-                f"attn_impl must be 'dense' or 'flash', got {self.attn_impl!r}")
+                f"attn_impl must be one of dense/flash/ring/ulysses, got "
+                f"{self.attn_impl!r}")
+        if self.n_seq < 1 or self.seq_len % self.n_seq:
+            raise ValueError(
+                f"seq_len {self.seq_len} not divisible by n_seq {self.n_seq}")
+        if self.n_seq > 1 and self.attn_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"n_seq={self.n_seq} needs a sequence-parallel attention "
+                f"(ring or ulysses), got {self.attn_impl!r}")
+        if (self.attn_impl == "ulysses" and self.n_seq > 1
+                and self.n_heads % self.n_seq):
+            raise ValueError(
+                f"ulysses needs n_heads ({self.n_heads}) divisible by "
+                f"n_seq ({self.n_seq})")
         if self.n_experts < 0 or (self.n_experts > 0 and not
                                   1 <= self.moe_top_k <= self.n_experts):
             raise ValueError(
                 f"invalid MoE config: n_experts={self.n_experts}, "
                 f"top_k={self.moe_top_k}")
+        if self.n_expert_parallel < 1 or (
+                self.n_expert_parallel > 1
+                and (self.n_experts == 0
+                     or self.n_experts % self.n_expert_parallel)):
+            raise ValueError(
+                f"n_expert_parallel={self.n_expert_parallel} needs "
+                f"n_experts ({self.n_experts}) > 0 and divisible by it")
 
 
 def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
@@ -90,37 +131,75 @@ def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
 
 
 def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
-                 deterministic: bool) -> jax.Array:
+                 deterministic: bool) -> tuple[jax.Array, jax.Array]:
+    """One transformer block. Returns ``(h, aux)`` — aux is the block's MoE
+    load-balancing loss (0 for a dense MLP block)."""
     k1, k2 = jax.random.split(key)
+    hn1 = layer_norm(params["ln1"], h)
     if cfg.attn_impl == "flash":
         from simple_distributed_machine_learning_tpu.ops.flash_attention import (
             flash_mha,
         )
-        a = flash_mha(params["attn"], layer_norm(params["ln1"], h),
-                      cfg.n_heads)
+        a = flash_mha(params["attn"], hn1, cfg.n_heads)
+    elif cfg.attn_impl == "ring" and cfg.n_seq > 1:
+        from simple_distributed_machine_learning_tpu.ops.attention import (
+            SEQ_AXIS,
+            ring_attention,
+        )
+        a = ring_attention(params["attn"], hn1, cfg.n_heads, axis=SEQ_AXIS)
+    elif cfg.attn_impl == "ulysses" and cfg.n_seq > 1:
+        from simple_distributed_machine_learning_tpu.parallel.sequence import (
+            ulysses_attention,
+        )
+        a = ulysses_attention(params["attn"], hn1, cfg.n_heads)
     else:
-        a = causal_attention(params["attn"], layer_norm(params["ln1"], h),
-                             cfg.n_heads)
+        # dense — also the n_seq == 1 degenerate case of ring/ulysses
+        # (identical math on the whole sequence)
+        a = causal_attention(params["attn"], hn1, cfg.n_heads)
     a = dropout(k1, a, cfg.dropout_rate, deterministic)
     h = h + a
     hn = layer_norm(params["ln2"], h)
+    aux = jnp.float32(0.0)
     if cfg.n_experts > 0:
         from simple_distributed_machine_learning_tpu.parallel.expert import (
+            EXPERT_AXIS,
             default_capacity,
             moe_apply,
+            moe_apply_ep,
         )
         # route per sequence (vmap over batch): keeps the [T, E, C] dispatch
         # tensors at seq_len scale instead of batch*seq_len (C grows with the
         # routed group size, so global routing would cost O((B*T)^2/E))
         cap = default_capacity(hn.shape[1], cfg.n_experts, cfg.moe_top_k,
                                cfg.moe_capacity_factor)
-        m, _aux = jax.vmap(
-            lambda t: moe_apply(params["moe"], t, k=cfg.moe_top_k,
-                                capacity=cap))(hn)
+        if cfg.n_expert_parallel > 1:
+            # expert-parallel: each expert-axis device takes its slice of the
+            # microbatch's SEQUENCES (routing groups identical to dense),
+            # runs the 2x-all-to-all EP FFN on its E/D expert shard, and the
+            # all_gather reassembles the batch (replicated again)
+            D = cfg.n_expert_parallel
+            b = hn.shape[0]
+            if b % D:
+                raise ValueError(
+                    f"microbatch of {b} sequences not divisible by "
+                    f"n_expert_parallel={D}")
+            nb = b // D
+            i = jax.lax.axis_index(EXPERT_AXIS)
+            hn_loc = jax.lax.dynamic_slice_in_dim(hn, i * nb, nb, 0)
+            m_loc, aux_v = jax.vmap(
+                lambda t: moe_apply_ep(params["moe"], t, k=cfg.moe_top_k,
+                                       capacity=cap))(hn_loc)
+            aux = jnp.mean(aux_v)   # already pmean'd over the expert axis
+            m = jax.lax.all_gather(m_loc, EXPERT_AXIS, axis=0, tiled=True)
+        else:
+            m, aux_v = jax.vmap(
+                lambda t: moe_apply(params["moe"], t, k=cfg.moe_top_k,
+                                    capacity=cap))(hn)
+            aux = jnp.mean(aux_v)
     else:
         m = linear(params["mlp_out"], jax.nn.gelu(linear(params["mlp_in"], hn)))
     m = dropout(k2, m, cfg.dropout_rate, deterministic)
-    return h + m
+    return h + m, aux
 
 
 def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
@@ -131,6 +210,13 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
     the last stage owns the final LN + head. Returns
     ``(stages, wire_dim, (seq_len, vocab))`` — pass the tuple as the
     Pipeline's ``out_dim`` for the per-token loss.
+
+    With ``cfg.n_seq > 1`` the stages are sequence-parallel: in_shapes and
+    ``wire_dim`` are per-seq-shard sizes (``seq_len / n_seq`` tokens), the
+    embedding stage offsets its positional slice by the shard's global
+    position, and attention runs as the configured seq collective. Build the
+    Pipeline on a ``make_mesh(..., n_seq=cfg.n_seq)`` mesh; the returned
+    out_dim stays GLOBAL — the engine reassembles the token axis.
     """
     if cfg.n_layers < n_stages and not (n_stages == 1 and cfg.n_layers == 0):
         raise ValueError(
@@ -144,6 +230,7 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
 
     per = [cfg.n_layers // n_stages + (1 if i < cfg.n_layers % n_stages else 0)
            for i in range(n_stages)]
+    t_loc = cfg.seq_len // cfg.n_seq        # tokens per seq shard
 
     stages: list[Stage] = []
     start = 0
@@ -158,23 +245,81 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
 
         def apply(params, x, key, deterministic,
                   _first=first, _last=last, _n=len(stage_blocks)):
+            if cfg.n_expert_parallel > 1:
+                # this stage's storage row is expert-sharded: expert weights
+                # are genuinely per-device, everything else (router, attn,
+                # norms, embed/head) is replicated-in-sharded-storage and
+                # needs grad_sync over the expert axis to receive its full
+                # gradient on every replica
+                params = _grad_sync_non_expert(params)
             if _first:
                 ids = x.astype(jnp.int32)                     # tokens on the wire
-                h = (embedding_lookup(params["embed"]["tok"], ids)
-                     + params["embed"]["pos"])
+                pos = params["embed"]["pos"]
+                if cfg.n_seq > 1:
+                    # this shard holds global positions [i*t_loc, (i+1)*t_loc)
+                    from simple_distributed_machine_learning_tpu.ops.attention import (
+                        SEQ_AXIS,
+                    )
+                    off = jax.lax.axis_index(SEQ_AXIS) * t_loc
+                    pos = jax.lax.dynamic_slice_in_dim(pos, off, t_loc, 0)
+                h = embedding_lookup(params["embed"]["tok"], ids) + pos
             else:
-                h = x                                         # [B, T, d]
+                h = x                                         # [B, T_loc, d]
+            aux = jnp.float32(0.0)
             for i in range(_n):
-                h = _block_apply(params["blocks"][i], h, cfg,
-                                 jax.random.fold_in(key, i), deterministic)
+                h, a = _block_apply(params["blocks"][i], h, cfg,
+                                    jax.random.fold_in(key, i), deterministic)
+                aux = aux + a
             if _last:
                 h = layer_norm(params["head"]["ln_f"], h)
-                return log_softmax(linear(params["head"]["out"], h))
+                h = log_softmax(linear(params["head"]["out"], h))
+            if cfg.n_experts > 0:
+                return h, cfg.moe_aux_weight * aux
             return h
 
-        in_shape = (cfg.seq_len,) if first else (cfg.seq_len, cfg.d_model)
-        stages.append(Stage(apply=apply, params=params, in_shape=in_shape))
+        in_shape = (t_loc,) if first else (t_loc, cfg.d_model)
+        if cfg.n_expert_parallel > 1:
+            shards = tuple(_slice_expert_shard(params, e, cfg)
+                           for e in range(cfg.n_expert_parallel))
+            stages.append(Stage(apply=apply, params=shards[0],
+                                in_shape=in_shape, expert_shards=shards))
+        else:
+            stages.append(Stage(apply=apply, params=params, in_shape=in_shape))
         start += per[s]
 
-    wire_dim = cfg.seq_len * max(cfg.d_model, cfg.vocab)
+    wire_dim = t_loc * max(cfg.d_model, cfg.vocab)
     return stages, wire_dim, (cfg.seq_len, cfg.vocab)
+
+
+def _is_expert_leaf(path) -> bool:
+    return any(getattr(p, "key", None) == "experts" for p in path)
+
+
+def _slice_expert_shard(params: dict, e: int, cfg: GPTConfig) -> dict:
+    """Expert-device ``e``'s param tree: blocks' ``experts`` leaves sliced
+    ``[e*E/D, (e+1)*E/D)`` on their leading expert axis, all else shared."""
+    import jax.tree_util as jtu
+
+    per = cfg.n_experts // cfg.n_expert_parallel
+    return jtu.tree_map_with_path(
+        lambda path, leaf: (leaf[e * per:(e + 1) * per]
+                            if _is_expert_leaf(path) else leaf),
+        params)
+
+
+def _grad_sync_non_expert(params: dict) -> dict:
+    """grad_sync every leaf EXCEPT the expert weights over the expert axis
+    (expert weights are genuinely sharded; their grads arrive through the
+    all-to-all transposes)."""
+    import jax.tree_util as jtu
+
+    from simple_distributed_machine_learning_tpu.parallel.expert import (
+        EXPERT_AXIS,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+        grad_sync,
+    )
+    return jtu.tree_map_with_path(
+        lambda path, leaf: (leaf if _is_expert_leaf(path)
+                            else grad_sync(leaf, EXPERT_AXIS)),
+        params)

@@ -128,9 +128,13 @@ def ring_attention(params: dict, x: jax.Array, n_heads: int,
         v_r = lax.ppermute(v_r, axis, perm)
         return (k_r, v_r, acc), None
 
+    # derive (l, m) from q so they inherit q's full varying-axes type — the
+    # scan carry must type-match the loop body under check_vma no matter
+    # which enclosing mesh axes (seq alone, or the pipeline's data/stage/
+    # model too) the inputs vary over
     acc0 = (jnp.zeros_like(q),
-            jnp.zeros((b, h, t_loc), q.dtype),
-            jnp.full((b, h, t_loc), -jnp.inf, q.dtype))
+            jnp.zeros_like(q[..., 0]),
+            jnp.full_like(q[..., 0], -jnp.inf))
     (_, _, (o, l, _)), _ = lax.scan(body, (k, v, acc0), jnp.arange(s))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return _merge_heads(out) @ params["wo"]

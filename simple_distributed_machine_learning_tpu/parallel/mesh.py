@@ -10,11 +10,17 @@ Replaces the reference's process bootstrap — argparse → env exports →
   activations hop stage→stage+1 via ``lax.ppermute``)
 - ``"model"`` — tensor (Megatron-style) parallelism within a stage (hidden
   dim sharded; one ``lax.psum`` per sharded pair — see ``tensor.py``)
+- ``"seq"``   — sequence/context parallelism (token axis sharded; ring
+  ppermute or Ulysses all-to-all per attention call — see ``sequence.py``,
+  ``ops/attention.py``)
+- ``"expert"`` — expert (MoE) parallelism (expert weights sharded; 2x
+  all-to-all dispatch per MoE layer — see ``expert.py``)
 
-Axis order is (data, stage, model), model fastest-varying: tensor-parallel
-psums are the chattiest collective so their group gets adjacent device ids;
-pipeline neighbours come next; data-parallel gradient all-reduce — once per
-step — tolerates the longest paths.
+Axis order is (data, stage, model, seq, expert), innermost fastest-varying:
+expert dispatch all-to-alls, sequence parallelism's per-layer ring hops and
+tensor parallelism's per-pair psums are the chattiest collectives so their
+groups get adjacent device ids; pipeline neighbours come next; data-parallel
+gradient all-reduce — once per step — tolerates the longest paths.
 """
 
 from __future__ import annotations
@@ -29,36 +35,44 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 STAGE_AXIS = "stage"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 
 
 def make_mesh(n_stages: int = 1, n_data: int | None = None,
-              n_model: int = 1,
+              n_model: int = 1, n_seq: int = 1, n_expert: int = 1,
               devices: Sequence[jax.Device] | None = None) -> Mesh:
-    """Build a ``(data, stage, model)`` mesh from the available devices.
+    """Build a ``(data, stage, model, seq, expert)`` mesh from the devices.
 
-    ``n_data`` defaults to ``len(devices) // (n_stages * n_model)`` so the
-    whole slice is used. The reference's topology was fixed at exactly 2 ranks
-    with the peer name hardcoded (``simple_distributed.py:34``); here the
-    topology is derived from the device list.
+    ``n_data`` defaults to ``len(devices) // (n_stages * n_model * n_seq *
+    n_expert)`` so the whole slice is used. The reference's topology was
+    fixed at exactly 2 ranks with the peer name hardcoded
+    (``simple_distributed.py:34``); here the topology is derived from the
+    device list.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
-    if n_stages < 1 or n_model < 1:
+    if n_stages < 1 or n_model < 1 or n_seq < 1 or n_expert < 1:
         raise ValueError(
-            f"n_stages/n_model must be >= 1, got {n_stages}/{n_model}")
+            f"n_stages/n_model/n_seq/n_expert must be >= 1, got "
+            f"{n_stages}/{n_model}/{n_seq}/{n_expert}")
+    per_replica = n_stages * n_model * n_seq * n_expert
     if n_data is None:
-        if len(devices) % (n_stages * n_model) != 0:
+        if len(devices) % per_replica != 0:
             raise ValueError(
                 f"{len(devices)} devices not divisible into {n_stages} "
-                f"pipeline stages x {n_model} model shards (pass n_data to "
-                f"use a subset)")
-        n_data = len(devices) // (n_stages * n_model)
-    need = n_data * n_stages * n_model
+                f"pipeline stages x {n_model} model shards x {n_seq} "
+                f"sequence shards x {n_expert} expert shards (pass n_data "
+                f"to use a subset)")
+        n_data = len(devices) // per_replica
+    need = n_data * per_replica
     if need > len(devices):
         raise ValueError(
-            f"mesh {n_data}x{n_stages}x{n_model} needs {need} devices, "
-            f"have {len(devices)}")
-    grid = np.array(devices[:need]).reshape(n_data, n_stages, n_model)
-    return Mesh(grid, (DATA_AXIS, STAGE_AXIS, MODEL_AXIS))
+            f"mesh {n_data}x{n_stages}x{n_model}x{n_seq}x{n_expert} needs "
+            f"{need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(
+        n_data, n_stages, n_model, n_seq, n_expert)
+    return Mesh(grid,
+                (DATA_AXIS, STAGE_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS))
 
 
 def bootstrap_distributed(rank: int, world_size: int, master_addr: str,
@@ -76,6 +90,14 @@ def bootstrap_distributed(rank: int, world_size: int, master_addr: str,
     if world_size <= 1:
         return  # single-process: nothing to rendezvous
     os.environ.setdefault("JAX_COORDINATOR_TIMEOUT_SECS", str(timeout_s))
+    try:
+        # cross-process collectives on the CPU backend need a transport; gloo
+        # is XLA:CPU's built-in one. On TPU this setting is simply unused
+        # (ICI/DCN collectives come with the TPU runtime). Must be set before
+        # backends initialize — harmless no-op if they already are.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (RuntimeError, ValueError):
+        pass
     jax.distributed.initialize(
         coordinator_address=f"{master_addr}:{master_port}",
         num_processes=world_size,

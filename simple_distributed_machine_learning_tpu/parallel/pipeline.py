@@ -39,9 +39,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
 from simple_distributed_machine_learning_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     STAGE_AXIS,
 )
+
+
+def _pvary_to(x: jax.Array, axes: tuple[str, ...]) -> jax.Array:
+    """pcast ``x`` to varying over exactly the axes of ``axes`` it does not
+    already vary over (pcast rejects mixed already/not-yet-varying sets)."""
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in have)
+    return lax.pcast(x, missing, to="varying") if missing else x
 from simple_distributed_machine_learning_tpu.parallel.staging import (
     StageMeta,
     pack_stage_params,
@@ -69,11 +79,27 @@ class Stage:
     sharded group with its psum. When ``shards`` is None on a mesh with
     ``n_model > 1``, ``params`` is replicated to every model slot and the
     stage computes redundantly (correct, just not sharded).
+
+    ``expert_shards``: optional per-expert-device params for expert (MoE)
+    parallelism — a tuple of ``n_expert`` pytrees (identical structure and
+    leaf shapes; typically the stage's expert weights split ``E/n_expert``
+    per device with everything else replicated). ``apply`` receives THIS
+    device's shard and may use collectives over the ``expert`` mesh axis
+    (e.g. ``expert.moe_apply_ep``); the apply is responsible for grad-syncing
+    its replicated (non-expert) leaves over the axis and must return the
+    same activation on every expert device (e.g. via ``all_gather``).
+    Mutually exclusive with ``shards``.
+
+    ``apply`` may return either ``y`` or ``(y, aux)`` — ``aux`` is a scalar
+    auxiliary loss (e.g. the MoE load-balancing term, already scaled by its
+    weight) that the engine adds to the objective (summed over stages,
+    averaged over microbatches/data shards).
     """
     apply: Callable[[Any, jax.Array, jax.Array, bool], jax.Array]
     params: Any
     in_shape: tuple[int, ...]
     shards: tuple | None = None
+    expert_shards: tuple | None = None
 
 
 class Pipeline:
@@ -95,6 +121,18 @@ class Pipeline:
         self.n_stages = mesh.shape[STAGE_AXIS]
         self.n_data = mesh.shape[DATA_AXIS]
         self.n_model = mesh.shape.get(MODEL_AXIS, 1)
+        # sequence/context parallelism: when the mesh has a seq axis, the
+        # token axis (axis 0 of every stage's in_shape and of out_shape) is
+        # sharded over it. Stage in_shapes and wire_dim are then LOCAL
+        # (per-seq-shard) sizes; out_dim stays GLOBAL (the host-facing logits
+        # shape). Stage applies use seq collectives (ring attention / Ulysses
+        # all-to-all) for any cross-token mixing.
+        self._has_seq = SEQ_AXIS in mesh.shape
+        self.n_seq = mesh.shape.get(SEQ_AXIS, 1)
+        # expert (MoE) parallelism: expert-sharded stages hold 1/n_expert of
+        # their expert weights per expert-axis device (see Stage.expert_shards)
+        self._has_expert = EXPERT_AXIS in mesh.shape
+        self.n_expert = mesh.shape.get(EXPERT_AXIS, 1)
         if len(self.stages) != self.n_stages:
             raise ValueError(
                 f"{len(self.stages)} stages but mesh stage axis is {self.n_stages}")
@@ -104,6 +142,19 @@ class Pipeline:
         self.out_shape = ((int(out_dim),) if isinstance(out_dim, int)
                           else tuple(int(d) for d in out_dim))
         self.out_dim = self.out_shape[-1]
+        if self.n_seq > 1:
+            if len(self.out_shape) < 2:
+                raise ValueError(
+                    "sequence parallelism (mesh seq axis > 1) requires a "
+                    "per-token output shape like (T, V); got "
+                    f"out_dim={out_dim!r}")
+            if self.out_shape[0] % self.n_seq:
+                raise ValueError(
+                    f"token axis {self.out_shape[0]} not divisible by "
+                    f"seq axis size {self.n_seq}")
+        # per-device output shape: token axis divided over the seq shards
+        self.out_local = ((self.out_shape[0] // self.n_seq,)
+                          + self.out_shape[1:])
         self.n_microbatches = int(n_microbatches)
         # mixed precision: params and activations are cast to compute_dtype
         # around each stage apply (bfloat16 doubles MXU throughput and halves
@@ -113,38 +164,52 @@ class Pipeline:
         self.compute_dtype = compute_dtype
         self.remat = bool(remat)
         self._sm_cache: dict[bool, Callable] = {}
-        # param buffer rows: one per (stage, model-shard). Stages without
-        # shards are replicated across the model axis (redundant compute,
-        # identical grads — the data-axis story, one level down).
+        # param buffer rows: one per (stage, model-shard, expert-shard).
+        # Stages without shards are replicated across the model/expert axes
+        # (redundant compute, identical grads — the data-axis story, one
+        # level down); expert-sharded stages genuinely split their expert
+        # weights' STORAGE across the expert axis.
         per_shard: list[Any] = []
         for s in self.stages:
-            if s.shards is not None:
-                if len(s.shards) != self.n_model:
-                    raise ValueError(
-                        f"stage has {len(s.shards)} model shards, mesh model "
-                        f"axis is {self.n_model}")
-                per_shard.extend(s.shards)
-            else:
-                per_shard.extend([s.params] * self.n_model)
+            if s.shards is not None and s.expert_shards is not None:
+                raise ValueError(
+                    "a stage cannot be both tensor- (shards) and expert- "
+                    "(expert_shards) sharded")
+            if s.shards is not None and len(s.shards) != self.n_model:
+                raise ValueError(
+                    f"stage has {len(s.shards)} model shards, mesh model "
+                    f"axis is {self.n_model}")
+            if (s.expert_shards is not None
+                    and len(s.expert_shards) != self.n_expert):
+                raise ValueError(
+                    f"stage has {len(s.expert_shards)} expert shards, mesh "
+                    f"expert axis is {self.n_expert}")
+            model_trees = (list(s.shards) if s.shards is not None
+                           else [s.params] * self.n_model)
+            for mt in model_trees:
+                if s.expert_shards is not None:
+                    per_shard.extend(s.expert_shards)
+                else:
+                    per_shard.extend([mt] * self.n_expert)
         flat, metas_all = pack_stage_params(per_shard)
         import numpy as np
         # keep the master copy on the HOST: device_put of an on-device array
         # with a matching sharding ALIASES it, and a later donated train step
         # would delete the alias — init_params() must survive any number of
         # donating steps
-        self._buf0 = np.asarray(
-            jax.device_get(flat.reshape(self.n_stages, self.n_model, -1)))
+        self._buf0 = np.asarray(jax.device_get(flat.reshape(
+            self.n_stages, self.n_model, self.n_expert, -1)))
         # shard 0's layout stands for the stage (shards are shape-identical)
-        self.metas = metas_all[:: self.n_model]
+        stride = self.n_model * self.n_expert
+        self.metas = metas_all[::stride]
         for s, stage in enumerate(self.stages):
-            if stage.shards is not None:
-                m0 = metas_all[s * self.n_model]
-                for m in metas_all[s * self.n_model:(s + 1) * self.n_model]:
+            if stage.shards is not None or stage.expert_shards is not None:
+                m0 = metas_all[s * stride]
+                for m in metas_all[s * stride:(s + 1) * stride]:
                     if m.shapes != m0.shapes:
                         raise ValueError(
-                            f"stage {s}: model shards have differing leaf "
-                            f"shapes — tensor-parallel shards must split "
-                            f"evenly")
+                            f"stage {s}: model/expert shards have differing "
+                            f"leaf shapes — sharded params must split evenly")
         self._validate_boundaries()
 
     def _validate_boundaries(self) -> None:
@@ -156,11 +221,16 @@ class Pipeline:
         """
         import numpy as np
         batch = 2
+        if self.n_seq > 1:
+            # seq-parallel stage applies use mesh collectives (ring ppermute /
+            # all-to-all), which have no meaning under eval_shape outside
+            # shard_map — the first real trace still shape-checks them
+            return
         for s, stage in enumerate(self.stages):
-            if stage.shards is not None:
-                # tensor-parallel applies use mesh collectives, which have no
-                # meaning under eval_shape outside shard_map — the first real
-                # trace still shape-checks them, just with a deeper trace
+            if stage.shards is not None or stage.expert_shards is not None:
+                # tensor-/expert-parallel applies use mesh collectives, which
+                # have no meaning under eval_shape outside shard_map — the
+                # first real trace still shape-checks them, just deeper
                 continue
             x = jax.ShapeDtypeStruct((batch,) + tuple(stage.in_shape), jnp.float32)
             key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
@@ -191,8 +261,10 @@ class Pipeline:
     # ---- parameters -----------------------------------------------------
 
     def param_spec(self) -> P:
-        """PartitionSpec of the packed ``[n_stages, n_model, P]`` buffer."""
-        return P(STAGE_AXIS, MODEL_AXIS, None)
+        """PartitionSpec of the packed ``[n_stages, n_model, n_expert, P]``
+        buffer."""
+        return P(STAGE_AXIS, MODEL_AXIS,
+                 EXPERT_AXIS if self._has_expert else None, None)
 
     def init_params(self) -> jax.Array:
         """Place the packed stage-param buffer on the mesh (stage- and
@@ -202,13 +274,22 @@ class Pipeline:
 
     def unpack(self, buf: jax.Array) -> list[Any]:
         """Host-side: recover the per-stage param pytrees (for tests/ckpt).
-        For model-sharded stages the entry is the list of per-shard trees."""
+        For model-/expert-sharded stages the entry is the list of per-shard
+        trees."""
         rows = jax.device_get(buf)
         out = []
         for s in range(self.n_stages):
-            trees = [unpack_stage_params(jnp.asarray(rows[s, m]), self.metas[s])
-                     for m in range(self.n_model)]
-            out.append(trees if self.stages[s].shards is not None else trees[0])
+            if self.stages[s].shards is not None:
+                out.append([unpack_stage_params(
+                    jnp.asarray(rows[s, m, 0]), self.metas[s])
+                    for m in range(self.n_model)])
+            elif self.stages[s].expert_shards is not None:
+                out.append([unpack_stage_params(
+                    jnp.asarray(rows[s, 0, e]), self.metas[s])
+                    for e in range(self.n_expert)])
+            else:
+                out.append(unpack_stage_params(
+                    jnp.asarray(rows[s, 0, 0]), self.metas[s]))
         return out
 
     # ---- forward/loss ---------------------------------------------------
@@ -222,41 +303,69 @@ class Pipeline:
         M = self.n_microbatches
         T = M + S - 1
         wire_dim = self.wire_dim
-        out_shape = self.out_shape
+        out_shape = self.out_local          # per-device (seq-local) shape
+        # the seq axis engages only for per-token outputs: a classifier has
+        # no token axis to shard, so its wire/targets/logits stay seq-
+        # replicated even on a mesh that has a seq axis
+        seq_on = self._has_seq and len(self.out_shape) > 1
+        n_seq = self.n_seq
         metas = list(self.metas)
         applies = [s.apply for s in self.stages]
         in_shapes = [s.in_shape for s in self.stages]
         n_model = self.n_model
-        # stages without model shards compute redundantly on every model slot;
-        # their params need the grad_sync treatment (see tensor.grad_sync) so
-        # each replica receives the full, not 1/n_model, gradient
+        n_expert = self.n_expert
+        # stages without model/expert shards compute redundantly on every
+        # slot of those axes; their params need the grad_sync treatment (see
+        # tensor.grad_sync) so each replica receives the full, not
+        # 1/axis_size, gradient
         replicated_over_model = [s.shards is None for s in self.stages]
+        replicated_over_expert = [s.expert_shards is None for s in self.stages]
         compute_dtype = self.compute_dtype
         remat = self.remat
+        # every mesh axis the loop's values can vary over (data via inputs,
+        # stage/model/expert via the param row, seq via the sharded wire)
+        vary_axes = (DATA_AXIS, STAGE_AXIS, MODEL_AXIS) + (
+            (SEQ_AXIS,) if seq_on else ()) + (
+            (EXPERT_AXIS,) if self._has_expert else ())
 
-        def per_device(row3d, x_mb, tgt_mb, w_mb, key):
-            # row3d: [1, 1, P] this device's (stage, model-shard) param row;
-            # x_mb: [M, mb, wire]; tgt_mb/w_mb: [M, mb] targets and weights
-            row = row3d[0, 0]
+        def per_device(row4d, x_mb, tgt_mb, w_mb, key):
+            # row4d: [1, 1, 1, P] this device's (stage, model-shard,
+            # expert-shard) param row; x_mb: [M, mb, wire]; tgt_mb/w_mb:
+            # [M, mb(...)] targets and weights
+            row = row4d[0, 0, 0]
             stage = lax.axis_index(STAGE_AXIS)
             mb = x_mb.shape[1]
 
             def make_branch(s):
                 def branch(wire, k):
+                    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+                        grad_sync,
+                    )
                     params = unpack_stage_params(row, metas[s])
                     if n_model > 1 and replicated_over_model[s]:
-                        from simple_distributed_machine_learning_tpu.parallel.tensor import (
-                            grad_sync,
-                        )
                         params = jax.tree.map(
                             lambda a: grad_sync(a, MODEL_AXIS), params)
+                    if n_expert > 1 and replicated_over_expert[s]:
+                        params = jax.tree.map(
+                            lambda a: grad_sync(a, EXPERT_AXIS), params)
                     x = wire_decode(wire, in_shapes[s])
                     if compute_dtype is not None:
                         params = jax.tree.map(
                             lambda a: a.astype(compute_dtype), params)
                         x = x.astype(compute_dtype)
                     y = applies[s](params, x, k, deterministic)
-                    return wire_encode(y.astype(jnp.float32), wire_dim)
+                    aux = jnp.float32(0.0)
+                    if isinstance(y, tuple):
+                        y, aux = y
+                        aux = aux.astype(jnp.float32)
+                    out = wire_encode(y.astype(jnp.float32), wire_dim)
+                    # uniformize branch output vma for lax.switch and the
+                    # scan carry: a TP stage's psum (or an EP stage's
+                    # all_gather) leaves its output less-varying than a
+                    # replicated stage's. Value-identity; the transpose
+                    # (psum of per-replica cotangents, each ct/n after the
+                    # loss pmean) reassembles the full cotangent.
+                    return _pvary_to(out, vary_axes), _pvary_to(aux, vary_axes)
                 if remat:
                     return jax.checkpoint(branch)
                 return branch
@@ -265,21 +374,29 @@ class Pipeline:
             fwd = [(i, (i + 1) % S) for i in range(S)]
 
             def step(carry, t):
-                wire, num_acc, den_acc, logits_acc = carry
+                wire, num_acc, den_acc, aux_acc, logits_acc = carry
                 # stage 0 injects a fresh microbatch every step (clipped so the
                 # drain steps recompute-and-discard the last one — finite math,
                 # zeroed below by the validity mask).
                 inj = lax.dynamic_index_in_dim(
                     x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
                 wire = jnp.where(stage == 0, inj, wire)
-                # distinct dropout noise per (step, stage, data-shard)
+                # distinct dropout noise per (step, stage, data-shard) — and
+                # per seq-shard when the token axis is sharded, so dropout
+                # patterns do not repeat chunk-to-chunk (left out of the fold
+                # at n_seq=1 to keep the fused path's RNG stream identical)
                 k_t = jax.random.fold_in(
                     jax.random.fold_in(jax.random.fold_in(key, t), stage),
                     lax.axis_index(DATA_AXIS))
-                out = lax.switch(stage, branches, wire, k_t)
+                if n_seq > 1:
+                    k_t = jax.random.fold_in(k_t, lax.axis_index(SEQ_AXIS))
+                out, aux = lax.switch(stage, branches, wire, k_t)
                 m = t - stage           # microbatch index this stage is working on
                 valid = (m >= 0) & (m < M)
                 out = jnp.where(valid, out, jnp.zeros_like(out))
+                # auxiliary losses (e.g. MoE load balancing) accumulate once
+                # per (stage, valid microbatch)
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
                 # last stage just produced log-probs for microbatch m
                 logits = wire_decode(out, out_shape)
                 is_out = valid & (stage == S - 1)
@@ -299,31 +416,76 @@ class Pipeline:
                 # the hop: stage s -> s+1 over ICI; autodiff transposes this
                 # into the backward s+1 -> s hop.
                 wire = lax.ppermute(out, STAGE_AXIS, fwd)
-                return (wire, num_acc, den_acc, logits_acc), None
+                return (wire, num_acc, den_acc, aux_acc, logits_acc), None
 
-            init = (jnp.zeros((mb, wire_dim), x_mb.dtype),
-                    jnp.float32(0.0), jnp.float32(0.0),
-                    jnp.zeros((M, mb) + out_shape, jnp.float32))
-            (_, num, den, logits_acc), _ = lax.scan(step, init, jnp.arange(T))
+            # the init carry is device-uniform but the loop body makes it
+            # vary over every mesh axis (params vary over stage/model/expert,
+            # data over data, seq-sharded tokens over seq); pcast aligns the
+            # carry types for check_vma
+            init = jax.tree.map(
+                lambda a: _pvary_to(a, vary_axes),
+                (jnp.zeros((mb, wire_dim), x_mb.dtype),
+                 jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
+                 jnp.zeros((M, mb) + out_shape, jnp.float32)))
+            (_, num, den, aux, logits_acc), _ = lax.scan(
+                step, init, jnp.arange(T))
 
             # weighted global mean: sum(w * nll) / sum(w), reduced over the
-            # stage axis (only the last stage contributed) and the data axis.
+            # stage axis (only the last stage contributed), the data axis,
+            # and — for a seq-sharded token axis — the seq axis.
             num = lax.psum(lax.psum(num, STAGE_AXIS), DATA_AXIS)
             den = lax.psum(lax.psum(den, STAGE_AXIS), DATA_AXIS)
-            loss = num / jnp.maximum(den, 1e-12)
-            logits = lax.psum(logits_acc, STAGE_AXIS)     # replicate last stage's
+            if seq_on:
+                num = lax.psum(num, SEQ_AXIS)
+                den = lax.psum(den, SEQ_AXIS)
+            # model-axis replication proof for check_vma: every model slot
+            # computed the same value (replicated stages run redundantly; TP
+            # stages end each pair in their own psum), so pmean is the
+            # identity value-wise — and gradient-wise: its transpose hands
+            # each replica ct/n_model, exactly what the implicit replicated
+            # out_spec did, which grad_sync already compensates for.
+            num = lax.pmean(num, MODEL_AXIS)
+            den = lax.pmean(den, MODEL_AXIS)
+            # auxiliary losses: summed over stages (each MoE stage adds its
+            # layers' terms), averaged over microbatches; data/seq/expert
+            # shards each routed a different token subset, so averaging over
+            # them matches the dense "mean over all routing groups"; model
+            # replicas are identical (pmean = replication proof).
+            aux = lax.psum(aux, STAGE_AXIS) / M
+            aux = lax.pmean(lax.pmean(aux, DATA_AXIS), MODEL_AXIS)
+            if seq_on:
+                aux = lax.pmean(aux, SEQ_AXIS)
+            if self._has_expert:
+                aux = lax.pmean(aux, EXPERT_AXIS)
+                num = lax.pmean(num, EXPERT_AXIS)
+                den = lax.pmean(den, EXPERT_AXIS)
+            loss = num / jnp.maximum(den, 1e-12) + aux
+            # logits stay seq-sharded (the out_spec reassembles the token
+            # axis); only the stage/model/expert axes are reduced away
+            logits = lax.pmean(                            # replicate last stage's
+                lax.psum(logits_acc, STAGE_AXIS), MODEL_AXIS)
+            if self._has_expert:
+                logits = lax.pmean(logits, EXPERT_AXIS)
             return loss, logits
 
+        # activations/targets are replicated over the model axis (left
+        # unmentioned); TP stages shard their compute internally and restore
+        # replication with their own psums. On a seq mesh, the wire's feature
+        # axis is sharded over seq (the host packs one contiguous
+        # wire_dim-wide chunk per seq shard), and the targets'/logits' token
+        # axis (axis 0 of out_shape) is sharded over seq directly.
+        tok_axes = len(self.out_shape) - 1
+        seq_or_none = SEQ_AXIS if seq_on else None
+        tgt_tok = ((seq_or_none,) + (None,) * (tok_axes - 1)
+                   if tok_axes else ())
         fn = jax.shard_map(
             per_device,
             mesh=self.mesh,
-            # activations/targets are replicated over the model axis (left
-            # unmentioned); TP stages shard their compute internally and
-            # restore replication with their own psums
-            in_specs=(P(STAGE_AXIS, MODEL_AXIS, None), P(None, DATA_AXIS, None),
-                      P(None, DATA_AXIS), P(None, DATA_AXIS), P()),
-            out_specs=(P(), P(None, DATA_AXIS)),
-            check_vma=False,
+            in_specs=(self.param_spec(),
+                      P(None, DATA_AXIS, seq_or_none),
+                      P(None, DATA_AXIS, *tgt_tok),
+                      P(None, DATA_AXIS), P()),
+            out_specs=(P(), P(None, DATA_AXIS, *tgt_tok, None)),
         )
         self._sm_cache[deterministic] = fn
         return fn
@@ -348,7 +510,9 @@ class Pipeline:
             raise ValueError(
                 f"batch {B} not divisible by microbatches*data = {M * self.n_data}")
         if (self.n_stages == 1 and self.n_data == 1 and self.n_model == 1
-                and self.stages[0].shards is None):
+                and self.n_seq == 1 and self.n_expert == 1
+                and self.stages[0].shards is None
+                and self.stages[0].expert_shards is None):
             # degenerate mesh: the pipeline IS the fused model. Skip the
             # shard_map engine — its packed-row unpack/repack costs ~10x the
             # model itself at this scale (grad of the slice/concat machinery),
@@ -357,8 +521,23 @@ class Pipeline:
                                     weights)
         # the wire is always float32 (stages decode/cast as needed — e.g. the
         # GPT embedding stage reads token ids back out of the float wire)
-        xw = wire_encode(x, self.wire_dim).astype(jnp.float32).reshape(
-            M, B // M, self.wire_dim)
+        if self.n_seq > 1:
+            # seq-sharded wire: chunk the token axis (axis 0 of the
+            # per-sample shape, so the flatten is token-major and each chunk
+            # is contiguous), pad each chunk to the LOCAL wire width, and lay
+            # the chunks side by side — the shard_map in_spec then hands each
+            # seq shard exactly its own wire_dim-wide chunk.
+            chunks = jnp.reshape(x, (B, self.n_seq, -1))
+            pad = self.wire_dim - chunks.shape[-1]
+            if pad < 0:
+                raise ValueError(
+                    f"per-shard activation width {chunks.shape[-1]} exceeds "
+                    f"wire_dim {self.wire_dim}")
+            xw = jnp.pad(chunks, ((0, 0), (0, 0), (0, pad)))
+        else:
+            xw = wire_encode(x, self.wire_dim)
+        xw = xw.astype(jnp.float32).reshape(
+            M, B // M, self.n_seq * self.wire_dim)
         tgt = targets.reshape((M, B // M) + self.out_shape[:-1])
         w = (jnp.ones((B,), jnp.float32) if weights is None
              else weights.astype(jnp.float32)).reshape(M, B // M)
@@ -376,7 +555,7 @@ class Pipeline:
 
         B = x.shape[0]
         stage = self.stages[0]
-        params = unpack_stage_params(buf[0, 0], self.metas[0])
+        params = unpack_stage_params(buf[0, 0, 0], self.metas[0])
         xs = x.reshape((B,) + tuple(stage.in_shape))
         if self.compute_dtype is not None:
             params = jax.tree.map(
@@ -384,13 +563,18 @@ class Pipeline:
             xs = xs.astype(self.compute_dtype)
         k = jax.random.fold_in(
             jax.random.fold_in(jax.random.fold_in(key, 0), 0), 0)
-        logp = stage.apply(params, xs, k, deterministic).astype(jnp.float32)
+        out = stage.apply(params, xs, k, deterministic)
+        aux = jnp.float32(0.0)
+        if isinstance(out, tuple):
+            out, aux = out
+            aux = aux.astype(jnp.float32)
+        logp = out.astype(jnp.float32)
         nll = nll_loss(logp, targets, "none")
         w = (jnp.ones((B,), jnp.float32) if weights is None
              else weights.astype(jnp.float32))
         wb = jnp.broadcast_to(
             w.reshape(w.shape + (1,) * (nll.ndim - 1)), nll.shape)
-        loss = jnp.sum(nll * wb) / jnp.maximum(jnp.sum(wb), 1e-12)
+        loss = jnp.sum(nll * wb) / jnp.maximum(jnp.sum(wb), 1e-12) + aux
         return loss, logp
 
 
@@ -404,5 +588,7 @@ def fused_reference(stages: Sequence[Stage]) -> Callable:
             k = jax.random.fold_in(key, s)
             h = h.reshape((h.shape[0],) + stage.in_shape)
             h = stage.apply(params, h, k, deterministic)
+            if isinstance(h, tuple):    # (y, aux): ground truth drops aux
+                h = h[0]
         return h
     return apply

@@ -91,10 +91,16 @@ def tp_pair_apply(params: dict, x: jax.Array, activation=jax.nn.relu,
     """Column→activation→row parallel pair. Call inside shard_map; ``params``
     is THIS device's shard. One psum over ``axis`` per call; the output bias
     is replicated and added after the reduce (see :func:`tp_pair_init`), with
-    :func:`grad_sync` restoring its full (unsplit) gradient."""
+    :func:`grad_sync` restoring its full (unsplit) gradient.
+
+    The ``pmean`` around the bias is the vma-checker's replication proof:
+    the replicas are bit-identical (grad_sync keeps them in sync), so it is
+    the identity value-wise, and its transpose (ct/n per replica) composes
+    with grad_sync's psum to hand every replica the full cotangent — the
+    same accounting the implicit replicated out_spec used to do."""
     h = activation(x @ params["w1"]["w"] + params["w1"]["b"])
-    return lax.psum(h @ params["w2"]["w"], axis) + grad_sync(
-        params["w2"]["b"], axis)
+    return lax.psum(h @ params["w2"]["w"], axis) + lax.pmean(
+        grad_sync(params["w2"]["b"], axis), axis)
 
 
 def stack_tp_shards(shards: list[dict]):
@@ -139,4 +145,6 @@ def make_mlp_tp_stages(key: jax.Array, dims, n_stages: int, n_model: int):
 
         stages.append(Stage(apply=apply, params=shards[0],
                             in_shape=(d_in,), shards=shards))
-    return stages, max(dims), dims[-1]
+    # only stage inputs/outputs (even-index dims) cross the wire; hidden
+    # widths live inside a stage and must not inflate the ppermute buffers
+    return stages, max(dims[::2]), dims[-1]

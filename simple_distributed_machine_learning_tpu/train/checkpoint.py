@@ -59,6 +59,15 @@ def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None
     if pipe is not None:
         from jax.sharding import NamedSharding
 
+        want = tuple(pipe._buf0.shape)
+        if tuple(params.shape) != want:
+            # pre-device_put check: an old-layout checkpoint (e.g. written
+            # before a topology/model change) would otherwise die inside
+            # device_put with an opaque sharding/rank error
+            raise ValueError(
+                f"checkpoint {path} does not match the model: packed param "
+                f"buffer is {tuple(params.shape)}, model expects {want} "
+                f"(different model/topology config?)")
         buf = jax.device_put(
             params, NamedSharding(pipe.mesh, pipe.param_spec()))
 

@@ -58,10 +58,13 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
         unpack_stage_params,
     )
 
-    # shards-is-None matters: a tensor-parallel stage's apply uses mesh
-    # collectives, which cannot be traced outside shard_map
+    # shards-is-None matters: a tensor-/expert-parallel stage's apply uses
+    # mesh collectives, which cannot be traced outside shard_map
     trivial_mesh = (pipe.n_stages == 1 and pipe.n_data == 1
-                    and pipe.n_model == 1 and pipe.stages[0].shards is None)
+                    and pipe.n_model == 1 and pipe.n_seq == 1
+                    and pipe.n_expert == 1
+                    and pipe.stages[0].shards is None
+                    and pipe.stages[0].expert_shards is None)
 
     from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
 
@@ -85,9 +88,9 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
             def repack(tree):
                 return pack_stage_params([tree])[0].reshape(buf.shape)
 
-            params0 = unpack_stage_params(buf[0, 0], meta)
+            params0 = unpack_stage_params(buf[0, 0, 0], meta)
             state0 = jax.tree.unflatten(os_def, [
-                unpack_stage_params(l[0, 0], meta) for l in os_leaves])
+                unpack_stage_params(l[0, 0, 0], meta) for l in os_leaves])
 
             def loss_tree(pp, x, t, k):
                 # same math and RNG stream as Pipeline._fused_loss
@@ -98,9 +101,13 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
                     pp = jax.tree.map(
                         lambda a: a.astype(pipe.compute_dtype), pp)
                     xs = xs.astype(pipe.compute_dtype)
-                logp = stage.apply(pp, xs, kk, False)
+                out = stage.apply(pp, xs, kk, False)
                 import jax.numpy as jnp
-                return nll_loss(logp.astype(jnp.float32), t, "mean")
+                aux = jnp.float32(0.0)
+                if isinstance(out, tuple):
+                    out, aux = out
+                    aux = aux.astype(jnp.float32)
+                return nll_loss(out.astype(jnp.float32), t, "mean") + aux
 
             def body(carry, batch):
                 p, s, i = carry

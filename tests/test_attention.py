@@ -56,7 +56,7 @@ def test_ring_attention_matches_full():
     ring = jax.jit(jax.shard_map(
         lambda p, xx: ring_attention(p, xx, h, "seq"),
         mesh=mesh, in_specs=(P(), P(None, "seq", None)),
-        out_specs=P(None, "seq", None), check_vma=False))
+        out_specs=P(None, "seq", None)))
     got = ring(params, x)
     want = causal_attention(params, x, h)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -75,7 +75,7 @@ def test_ring_attention_grads_match_full():
     def ring_loss(p, xx):
         f = jax.shard_map(lambda pp, v: ring_attention(pp, v, 2, "seq"),
                           mesh=mesh, in_specs=(P(), P(None, "seq", None)),
-                          out_specs=P(None, "seq", None), check_vma=False)
+                          out_specs=P(None, "seq", None))
         return jnp.sum(f(p, xx) ** 2)
 
     def full_loss(p, xx):

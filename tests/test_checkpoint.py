@@ -51,3 +51,21 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
 
     np.testing.assert_array_equal(np.asarray(buf_a), np.asarray(buf_b))
     np.testing.assert_array_equal(np.asarray(state_a), np.asarray(state_b))
+
+
+def test_restore_rejects_mismatched_buffer_shape(tmp_path):
+    """An old-layout checkpoint must fail with a descriptive shape error
+    BEFORE device_put can raise an opaque sharding/rank error (ADVICE r1)."""
+    import pytest
+
+    key = jax.random.key(1)
+    stages, wd, od = make_mlp_stages(key, [12, 16, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wd, od)
+    buf = pipe.init_params()
+    path = str(tmp_path / "old.npz")
+    # simulate a checkpoint from the pre-[n_stages, n_model, P] layout
+    save_checkpoint(path, np.asarray(jax.device_get(buf))[:, 0, :],
+                    opt_state=[], step=1)
+    with pytest.raises(ValueError, match="does not match the model"):
+        restore_checkpoint(path, pipe=pipe)

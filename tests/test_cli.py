@@ -28,3 +28,8 @@ def test_cli_end_to_end_single_process(capsys):
     out = capsys.readouterr().out
     assert "Train Epoch: 1 [0/6000 (0%)]" in out
     assert "Test set: Average loss:" in out
+
+
+def test_cli_sp_requires_gpt():
+    with pytest.raises(SystemExit, match="--sp is only supported"):
+        main(["--rank", "0", "--model", "mlp", "--sp", "2"])

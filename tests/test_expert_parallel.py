@@ -27,7 +27,7 @@ def _ep_fn(mesh, k, capacity):
     return jax.jit(jax.shard_map(
         per_device, mesh=mesh,
         in_specs=(pspec, P("expert")), out_specs=(P("expert"), P()),
-        check_vma=False))
+        ))
 
 
 def test_ep_matches_dense_per_shard():

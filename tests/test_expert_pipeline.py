@@ -1,0 +1,100 @@
+"""Expert parallelism composed with the pipeline (VERDICT r1 item 4).
+
+MoE-GPT with its expert weights genuinely sharded over the mesh's "expert"
+axis — per-device expert storage rows in the packed buffer, sequence-split
+routing, 2x all-to-all dispatch inside the engine's shard_map — must match
+the dense (n_expert_parallel=1) pipeline exactly: same routing groups (one
+sequence each), same capacity, so values, aux loss, and SGD trajectories are
+identical.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from simple_distributed_machine_learning_tpu.models.gpt import (
+    GPTConfig,
+    make_gpt_stages,
+)
+from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+from simple_distributed_machine_learning_tpu.train.optimizer import sgd
+from simple_distributed_machine_learning_tpu.train.step import make_train_step
+
+CFG = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2,
+                n_experts=4, moe_top_k=2)
+
+
+def _data(key, batch):
+    kx, ky = jax.random.split(key)
+    x = jax.random.randint(kx, (batch, CFG.seq_len), 0, CFG.vocab)
+    y = jax.random.randint(ky, (batch, CFG.seq_len), 0, CFG.vocab)
+    return x.astype(jax.numpy.float32), y
+
+
+def _pipe(n_ep, n_micro=2):
+    cfg = dataclasses.replace(CFG, n_expert_parallel=n_ep)
+    stages, wd, od = make_gpt_stages(jax.random.key(0), cfg, 2)
+    mesh = make_mesh(n_stages=2, n_data=1, n_expert=n_ep)
+    return Pipeline(stages, mesh, wd, od, n_microbatches=n_micro)
+
+
+def test_ep_buffer_is_expert_sharded():
+    pipe = _pipe(2)
+    assert pipe.n_expert == 2
+    buf = pipe.init_params()
+    # [n_stages, n_model, n_expert, P]: expert rows differ (sharded storage)
+    assert buf.shape[:3] == (2, 1, 2)
+    rows = np.asarray(jax.device_get(buf))
+    assert not np.array_equal(rows[0, 0, 0], rows[0, 0, 1])
+    assert "expert" in str(buf.sharding.spec)
+
+
+def test_ep_pipeline_matches_dense_pipeline():
+    x, y = _data(jax.random.key(1), 8)
+    key = jax.random.key(2)
+    dense = _pipe(1)
+    ld, logits_d = dense.loss_and_logits(dense.init_params(), x, y, key,
+                                         deterministic=True)
+    ep = _pipe(2)
+    le, logits_e = ep.loss_and_logits(ep.init_params(), x, y, key,
+                                      deterministic=True)
+    np.testing.assert_allclose(float(le), float(ld), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(logits_e), np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ep_sgd_trajectory_matches_dense():
+    """Gradients through the all-to-all dispatch, the expert-sharded storage
+    rows, and the grad-synced replicated leaves reproduce dense training."""
+    x, y = _data(jax.random.key(3), 8)
+    opt = sgd(0.1, momentum=0.5)
+    losses = {}
+    for name, pipe in (("dense", _pipe(1)), ("ep", _pipe(2))):
+        buf = pipe.init_params()
+        state = opt.init(buf)
+        step = make_train_step(pipe, opt)
+        ls = []
+        for i in range(3):
+            buf, state, loss = step(buf, state, x, y,
+                                    jax.random.fold_in(jax.random.key(4), i))
+            ls.append(float(loss))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["ep"], losses["dense"],
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_ep_composes_with_data_parallel():
+    """dp=2 x pp=2 x ep=2 = 8 devices, one train step, finite loss."""
+    cfg = dataclasses.replace(CFG, n_expert_parallel=2)
+    stages, wd, od = make_gpt_stages(jax.random.key(5), cfg, 2)
+    mesh = make_mesh(n_stages=2, n_data=2, n_expert=2)
+    pipe = Pipeline(stages, mesh, wd, od, n_microbatches=2)
+    x, y = _data(jax.random.key(6), 8)
+    opt = sgd(0.1, momentum=0.5)
+    buf = pipe.init_params()
+    state = opt.init(buf)
+    step = make_train_step(pipe, opt)
+    buf, state, loss = step(buf, state, x, y, jax.random.key(7))
+    assert np.isfinite(float(loss))

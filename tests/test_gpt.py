@@ -107,9 +107,21 @@ def test_moe_gpt_pipeline_trains():
     buf = pipe.init_params()
 
     loss, _ = pipe.loss_and_logits(buf, x, y, key, deterministic=True)
-    fused = fused_reference(stages)
-    want = nll_loss(fused([s.params for s in stages], x, key, True), y, "mean")
-    np.testing.assert_allclose(float(loss), float(want), rtol=2e-5, atol=2e-5)
+    # the engine's objective = NLL + the Switch aux loss the stages return
+    h = x
+    aux = 0.0
+    for s, st in enumerate(stages):
+        k = jax.random.fold_in(key, s)
+        out = st.apply(st.params, h.reshape((h.shape[0],) + st.in_shape),
+                       k, True)
+        # per-sequence routing makes the full-batch aux equal the engine's
+        # microbatch-averaged aux (mean over all sequences either way)
+        h, a = out
+        aux += float(a)
+    want = nll_loss(h, y, "mean")
+    assert aux > 0.0   # balancing pressure is real, not dropped (ADVICE r1)
+    np.testing.assert_allclose(float(loss), float(want) + aux,
+                               rtol=2e-5, atol=2e-5)
 
     opt = sgd(0.3, momentum=0.5)
     opt_state = opt.init(buf)

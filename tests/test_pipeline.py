@@ -79,9 +79,10 @@ def test_pipeline_matches_fused_loss_and_grad(n_stages, n_data, n_micro):
         lambda ps: _fused_loss(stages, ps, x, targets)
     )([s.params for s in stages])
     want_buf, _ = pack_stage_params(fused_grads)
-    # grads buffer is [n_stages, n_model=1, P]; fused pack is [n_stages, P]
-    np.testing.assert_allclose(np.asarray(grads)[:, 0], np.asarray(want_buf),
-                               rtol=5e-5, atol=5e-5)
+    # grads buffer is [n_stages, n_model=1, n_expert=1, P]; fused pack is
+    # [n_stages, P]
+    np.testing.assert_allclose(np.asarray(grads)[:, 0, 0],
+                               np.asarray(want_buf), rtol=5e-5, atol=5e-5)
 
 
 def test_training_trajectory_matches_fused():

@@ -19,7 +19,7 @@ def _sharded(fn, mesh, h):
     return jax.jit(jax.shard_map(
         lambda p, xx: fn(p, xx, h, "seq"),
         mesh=mesh, in_specs=(P(), P(None, "seq", None)),
-        out_specs=P(None, "seq", None), check_vma=False))
+        out_specs=P(None, "seq", None)))
 
 
 def test_ulysses_matches_full():
@@ -45,7 +45,7 @@ def test_ulysses_grads_match_full():
     def sp_loss(p, xx):
         f = jax.shard_map(lambda pp, v: ulysses_attention(pp, v, h, "seq"),
                           mesh=mesh, in_specs=(P(), P(None, "seq", None)),
-                          out_specs=P(None, "seq", None), check_vma=False)
+                          out_specs=P(None, "seq", None))
         return jnp.sum(f(p, xx) ** 2)
 
     def dense_loss(p, xx):

@@ -35,7 +35,7 @@ def test_tp_pair_matches_dense():
 
     f = jax.jit(jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(P("model"), P()), out_specs=P(), check_vma=False))
+        in_specs=(P("model"), P()), out_specs=P()))
     got = f(stacked, x)
     want = _dense_pair(key, d_in, d_h, d_out, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -55,7 +55,7 @@ def test_tp_pair_grads_match_dense():
             lambda pp, v: tp_pair_apply(jax.tree.map(lambda l: l[0], pp), v,
                                         axis="model"),
             mesh=mesh, in_specs=(P("model"), P()), out_specs=P(),
-            check_vma=False)
+            )
         return jnp.sum(f(p, xx) ** 2)
 
     g_tp = jax.grad(tp_loss)(stacked, x)

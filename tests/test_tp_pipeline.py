@@ -102,7 +102,7 @@ def test_full_3d_mesh_all_axes_active():
     """(data=2, stage=2, model=2) = 8 devices: one train step runs and the
     replicated-over-data, sharded-over-(stage,model) buffer stays finite."""
     _, pipe, x, y = _problem(n_model=2, n_data=2, batch=8)
-    assert dict(pipe.mesh.shape) == {"data": 2, "stage": 2, "model": 2}
+    assert dict(pipe.mesh.shape) == {"data": 2, "stage": 2, "model": 2, "seq": 1, "expert": 1}
     buf = pipe.init_params()
     opt = sgd(0.1, momentum=0.5)
     step = make_train_step(pipe, opt)
