@@ -237,6 +237,9 @@ class Pipeline:
             out = jax.eval_shape(
                 lambda p, xx, kk, _a=stage.apply: _a(p, xx, kk, True),
                 stage.params, x, key)
+            if isinstance(out, tuple):
+                # MoE stages return (y, aux_loss); only y rides the wire
+                out = out[0]
             out_size = int(np.prod(out.shape[1:]))
             if out_size > self.wire_dim:
                 raise ValueError(

@@ -67,8 +67,9 @@ def test_gpt_pipeline_grads_match_fused():
 
     fg = jax.grad(fused_loss)([s.params for s in stages])
     want, _ = pack_stage_params(fg)
-    # grads buffer is [n_stages, n_model=1, P]; fused pack is [n_stages, P]
-    np.testing.assert_allclose(np.asarray(grads)[:, 0], np.asarray(want),
+    # grads buffer is [n_stages, n_model=1, n_expert=1, P]; fused pack is
+    # [n_stages, P]
+    np.testing.assert_allclose(np.asarray(grads)[:, 0, 0], np.asarray(want),
                                rtol=1e-4, atol=1e-4)
 
 
