@@ -217,30 +217,33 @@ class Pipeline:
 
         The wire codec zero-pads/truncates, so a stage whose output width does
         not match the next stage's ``in_shape`` would otherwise train silently
-        on fabricated zeros.
+        on fabricated zeros. Plain stages are eval_shape'd directly; TP-, EP-
+        and seq-parallel stage applies use mesh collectives (psum /
+        all-to-all / ring ppermute), so they are traced under a ``shard_map``
+        over the real mesh (``check_vma=False`` — only shape semantics are
+        wanted here) and validated on per-shard feature widths.
         """
         import numpy as np
         batch = 2
-        if self.n_seq > 1:
-            # seq-parallel stage applies use mesh collectives (ring ppermute /
-            # all-to-all), which have no meaning under eval_shape outside
-            # shard_map — the first real trace still shape-checks them
-            return
         for s, stage in enumerate(self.stages):
-            if stage.shards is not None or stage.expert_shards is not None:
-                # tensor-/expert-parallel applies use mesh collectives, which
-                # have no meaning under eval_shape outside shard_map — the
-                # first real trace still shape-checks them, just deeper
-                continue
-            x = jax.ShapeDtypeStruct((batch,) + tuple(stage.in_shape), jnp.float32)
-            key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
-            out = jax.eval_shape(
-                lambda p, xx, kk, _a=stage.apply: _a(p, xx, kk, True),
-                stage.params, x, key)
-            if isinstance(out, tuple):
-                # MoE stages return (y, aux_loss); only y rides the wire
-                out = out[0]
-            out_size = int(np.prod(out.shape[1:]))
+            on_mesh = (self.n_seq > 1 or stage.shards is not None
+                       or stage.expert_shards is not None)
+            exact_shape = None
+            if on_mesh:
+                shard_shape = self._sharded_out_shape(stage, batch)
+                out_size = int(np.prod(shard_shape))
+            else:
+                x = jax.ShapeDtypeStruct((batch,) + tuple(stage.in_shape),
+                                         jnp.float32)
+                key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+                out = jax.eval_shape(
+                    lambda p, xx, kk, _a=stage.apply: _a(p, xx, kk, True),
+                    stage.params, x, key)
+                if isinstance(out, tuple):
+                    # MoE stages return (y, aux_loss); only y rides the wire
+                    out = out[0]
+                exact_shape = out.shape
+                out_size = int(np.prod(out.shape[1:]))
             if out_size > self.wire_dim:
                 raise ValueError(
                     f"stage {s} output width {out_size} exceeds wire_dim "
@@ -252,14 +255,76 @@ class Pipeline:
                         f"stage {s} outputs {out_size} features but stage "
                         f"{s + 1} declares in_shape={self.stages[s + 1].in_shape} "
                         f"({nxt} features)")
-            elif out.shape[1:] != self.out_shape:
+            elif exact_shape is not None:
+                if exact_shape[1:] != self.out_shape:
+                    raise ValueError(
+                        f"last stage must output [batch, *{self.out_shape}], "
+                        f"got {exact_shape}")
+            elif shard_shape != tuple(self.out_local):
+                per = ("per seq shard " if self.n_seq > 1 else "")
                 raise ValueError(
-                    f"last stage must output [batch, *{self.out_shape}], got "
-                    f"{out.shape}")
+                    f"last stage outputs {shard_shape} {per}but the pipeline "
+                    f"declares out_shape={self.out_shape} "
+                    f"({tuple(self.out_local)} {per.strip() or 'per device'})")
             if int(np.prod(stage.in_shape)) > self.wire_dim:
                 raise ValueError(
                     f"stage {s} in_shape {stage.in_shape} exceeds wire_dim "
                     f"{self.wire_dim}")
+
+    def _sharded_out_shape(self, stage: Stage, batch: int) -> tuple[int, ...]:
+        """Per-shard output feature shape of a TP/EP/seq stage, traced under
+        ``shard_map`` on the real mesh with zero FLOPs (``jax.eval_shape``).
+
+        Params ride in stacked over their shard axis (model or expert) so
+        each device sees its own shard; in a seq mesh the activation's token
+        axis (axis 0 of ``in_shape``) is sharded over the seq axis. The
+        per-shard shape is captured at trace time (shapes are static), since
+        the shard_map out_spec only reassembles a flattened width.
+        """
+        if stage.expert_shards is not None:
+            trees, p_axis = stage.expert_shards, EXPERT_AXIS
+        elif stage.shards is not None:
+            trees, p_axis = stage.shards, MODEL_AXIS
+        else:
+            trees, p_axis = None, None
+        if trees is not None:
+            p_sds = jax.tree.map(
+                lambda *ls: jax.ShapeDtypeStruct((len(ls),) + ls[0].shape,
+                                                 ls[0].dtype), *trees)
+            p_spec, unstack = P(p_axis), True
+        else:
+            p_sds = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), stage.params)
+            p_spec, unstack = P(), False
+
+        in_local = tuple(stage.in_shape)
+        if self.n_seq > 1:
+            x_glob = (batch, in_local[0] * self.n_seq) + in_local[1:]
+            x_spec = P(None, SEQ_AXIS, *(None,) * (len(in_local) - 1))
+        else:
+            x_glob = (batch,) + in_local
+            x_spec = P(*(None,) * (len(in_local) + 1))
+        x = jax.ShapeDtypeStruct(x_glob, jnp.float32)
+        key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+
+        shard_shape: list[tuple[int, ...]] = []
+
+        def run(p, xx, kk):
+            if unstack:
+                p = jax.tree.map(lambda a: a[0], p)   # this device's shard
+            y = stage.apply(p, xx, kk, True)
+            if isinstance(y, tuple):
+                y = y[0]
+            shard_shape.append(tuple(y.shape[1:]))
+            return y.reshape(xx.shape[0], -1)
+
+        fn = jax.shard_map(
+            run, mesh=self.mesh,
+            in_specs=(p_spec, x_spec, P()),
+            out_specs=P(None, SEQ_AXIS if self.n_seq > 1 else None),
+            check_vma=False)
+        jax.eval_shape(fn, p_sds, x, key)
+        return shard_shape[0]
 
     # ---- parameters -----------------------------------------------------
 
@@ -450,10 +515,12 @@ class Pipeline:
             num = lax.pmean(num, MODEL_AXIS)
             den = lax.pmean(den, MODEL_AXIS)
             # auxiliary losses: summed over stages (each MoE stage adds its
-            # layers' terms), averaged over microbatches; data/seq/expert
-            # shards each routed a different token subset, so averaging over
-            # them matches the dense "mean over all routing groups"; model
-            # replicas are identical (pmean = replication proof).
+            # layers' terms), averaged UNWEIGHTED over microbatches — sample
+            # weights scale the NLL term only (see loss_and_logits docstring);
+            # data/seq/expert shards each routed a different token subset, so
+            # averaging over them matches the dense "mean over all routing
+            # groups"; model replicas are identical (pmean = replication
+            # proof).
             aux = lax.psum(aux, STAGE_AXIS) / M
             aux = lax.pmean(lax.pmean(aux, DATA_AXIS), MODEL_AXIS)
             if seq_on:
@@ -504,6 +571,15 @@ class Pipeline:
         weights (e.g. a 0/1 validity mask for a zero-padded ragged batch —
         loss = sum(w·nll)/sum(w), so padding does not dilute the mean). B must
         divide by ``n_microbatches * n_data``.
+
+        ``weights`` applies to the NLL term ONLY. MoE auxiliary
+        (load-balancing) losses are accumulated unweighted — a uniform mean
+        over microbatches — exactly as the dense path computes aux over the
+        full batch including zero-weight rows: router balance is a property
+        of every token that was dispatched, padding included, so weighting it
+        would let padded batches skew expert utilisation pressure
+        (pinned by tests/test_expert_pipeline.py::
+        test_weighted_loss_applies_to_nll_only).
         """
         import jax.numpy as jnp
 

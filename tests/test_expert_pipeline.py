@@ -85,6 +85,43 @@ def test_ep_sgd_trajectory_matches_dense():
                                rtol=5e-5, atol=5e-5)
 
 
+def test_weighted_loss_applies_to_nll_only():
+    """Documented contract (pipeline.loss_and_logits): per-sample ``weights``
+    scale the NLL term only; MoE aux load-balancing terms stay unweighted,
+    matching the dense path which computes aux over the full batch."""
+    import jax.numpy as jnp
+
+    from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
+
+    x, y = _data(jax.random.key(8), 8)
+    w = jnp.asarray([1.0, 1.0, 1.0, 0.5, 2.0, 0.0, 1.5, 1.0])
+    pipe = _pipe(1, n_micro=1)
+    buf = pipe.init_params()
+    loss, _ = pipe.loss_and_logits(buf, x, y, jax.random.key(9),
+                                   deterministic=True, weights=w)
+
+    # dense ground truth: weighted-mean NLL + UNWEIGHTED sum of stage aux
+    h, aux = x, jnp.float32(0.0)
+    for s, stage in enumerate(pipe.stages):
+        h = h.reshape((h.shape[0],) + tuple(stage.in_shape))
+        out = stage.apply(stage.params, h,
+                          jax.random.fold_in(jax.random.key(9), s), True)
+        if isinstance(out, tuple):
+            out, a = out
+            aux = aux + a
+        h = out
+    nll = nll_loss(h, y, "none")
+    wb = jnp.broadcast_to(w[:, None], nll.shape)
+    want = jnp.sum(nll * wb) / jnp.sum(wb) + aux
+    np.testing.assert_allclose(float(loss), float(want), rtol=2e-5, atol=2e-5)
+
+    # scaling every weight leaves the loss identical: the weighted mean is
+    # scale-invariant and aux never sees the weights
+    loss2, _ = pipe.loss_and_logits(buf, x, y, jax.random.key(9),
+                                    deterministic=True, weights=w * 7.0)
+    np.testing.assert_allclose(float(loss2), float(loss), rtol=1e-6, atol=1e-6)
+
+
 def test_ep_composes_with_data_parallel():
     """dp=2 x pp=2 x ep=2 = 8 devices, one train step, finite loss."""
     cfg = dataclasses.replace(CFG, n_expert_parallel=2)
