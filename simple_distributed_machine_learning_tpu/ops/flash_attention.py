@@ -193,77 +193,189 @@ def _flash_fwd(q, k, v, block_q, block_k):
     return o, (q, k, v, o, l, m)
 
 
-def _flash_bwd(block_q, block_k, res, do):
-    """Recompute-based backward (flash-attention-2 style), in plain XLA.
+def _recompute_p(q_ref, k_ref, m_ref, li_ref, q_start, k_start,
+                 block_q, block_k, t_real, scale):
+    """Shared backward-block math: re-derive the probability block
+    ``p = exp(s - m) / l`` from the saved softmax statistics (exactly the
+    forward's value — no [T, T] residuals; flash-attention-2 practice)."""
+    qs = q_ref[0].astype(jnp.float32) * scale             # [bq, d]
+    kk = k_ref[0].astype(jnp.float32)                     # [bk, d]
+    s = jax.lax.dot_general(qs, kk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    qpos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kpos = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = (qpos >= kpos) & (kpos < t_real) & (qpos < t_real)
+    m_row = m_ref[0]                                      # [bq]
+    li_row = li_ref[0]
+    p = jnp.where(mask, jnp.exp(s - m_row[:, None]) * li_row[:, None], 0.0)
+    return qs, kk, p
 
-    The saved (l, m) let each score block be re-derived exactly:
-    ``p = exp(s - m) / l``; then dv = pᵀ·do, dp = do·vᵀ,
-    ds = p*(dp - rowsum(do*o)), dq = ds·k, dk = dsᵀ·q. Blocked: an outer scan
-    walks q-blocks and an inner diagonal-bounded ``fori_loop`` walks only the
-    k-blocks at or before the causal diagonal, so (like the forward kernel)
-    fully-masked blocks cost nothing and no [T, T] matrix is ever whole.
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, li_ref, dl_ref,
+               dq_ref, dq_scr, *,
+               block_q: int, block_k: int, t_real: int, scale: float):
+    """dq pass: grid (bh, q-block, k-block), k innermost.
+
+    For a fixed q block the scratch accumulates ``dq += ds·k·scale`` across
+    its (diagonal-bounded) k blocks; ``ds = p*(dp - delta)`` with
+    ``dp = do·vᵀ`` and ``delta = rowsum(do*o)`` precomputed outside.
+    """
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    # causal: k-blocks wholly past the diagonal contribute nothing — skip
+    @pl.when(k_start < q_start + block_q)
+    def _compute():
+        _, kk, p = _recompute_p(q_ref, k_ref, m_ref, li_ref, q_start,
+                                k_start, block_q, block_k, t_real, scale)
+        do = do_ref[0].astype(jnp.float32)                # [bq, d]
+        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0][:, None])
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, m_ref, li_ref, dl_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *,
+                block_q: int, block_k: int, t_real: int, scale: float):
+    """dk/dv pass: grid (bh, k-block, q-block), q innermost.
+
+    For a fixed k block the scratch accumulates ``dv += pᵀ·do`` and
+    ``dk += dsᵀ·(q·scale)`` across its q blocks, starting at the causal
+    diagonal (earlier q blocks are fully masked).
+    """
+    kbi = pl.program_id(1)
+    qb = pl.program_id(2)
+    n_qb = pl.num_programs(2)
+    k_start = kbi * block_k
+    q_start = qb * block_q
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # causal: q-blocks wholly before this k block see none of it — skip
+    @pl.when(q_start + block_q > k_start)
+    def _compute():
+        qs, _, p = _recompute_p(q_ref, k_ref, m_ref, li_ref, q_start,
+                                k_start, block_q, block_k, t_real, scale)
+        do = do_ref[0].astype(jnp.float32)                # [bq, d]
+        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),              # pᵀ·do -> [bk, d]
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0][:, None])
+        dk_scr[...] += jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),             # dsᵀ·qs -> [bk, d]
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qb == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(block_q, block_k, res, do):
+    """Pallas recompute-based backward (flash-attention-2 style).
+
+    Two kernels with the forward's blocking: a dq pass (k innermost,
+    diagonal-bounded like the forward) and a dk/dv pass (q innermost,
+    starting at the diagonal). Both re-derive each probability block from
+    the saved (l, m) — ``p = exp(s - m)/l`` — so no [T, T] matrix and no
+    attention-weight residuals ever exist; VMEM stays O(block·d) per cell.
     """
     q, k, v, o, l, m = res
     b, h, t, dh = q.shape
     scale = 1.0 / math.sqrt(dh)
-    qf = _pad_to(q.astype(jnp.float32) * scale, 2, block_q)
-    dof = _pad_to(do.astype(jnp.float32), 2, block_q)
-    # delta_i = sum_j do_ij * o_ij  (rowwise), the softmax-jacobian constant
-    delta = _pad_to((do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1),
-                    2, block_q)
-    # padded q rows: m stays finite (0), l -> inv 0; rows are cropped anyway
+    # delta_i = sum_j do_ij * o_ij (rowwise), the softmax-jacobian constant
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    # padded q rows: mask has qpos >= t_real, so their p-blocks are all-zero;
+    # linv pads to 0 as belt-and-braces
     mp = _pad_to(m, 2, block_q)
     linvp = _pad_to(1.0 / jnp.maximum(l, 1e-30), 2, block_q)
-    kpad = _pad_to(k.astype(jnp.float32), 2, block_k)
-    vpad = _pad_to(v.astype(jnp.float32), 2, block_k)
-    tqp, tkp = qf.shape[2], kpad.shape[2]
-    n_qb, n_kb = tqp // block_q, tkp // block_k
+    dlp = _pad_to(delta, 2, block_q)
+    qp = _pad_to(_pad_to(q, 3, _LANES), 2, block_q)
+    dop = _pad_to(_pad_to(do, 3, _LANES), 2, block_q)
+    kp = _pad_to(_pad_to(k, 3, _LANES), 2, block_k)
+    vp = _pad_to(_pad_to(v, 3, _LANES), 2, block_k)
+    tq, dp_ = qp.shape[2], qp.shape[3]
+    tk = kp.shape[2]
+    bh = b * h
+    qp = qp.reshape(bh, tq, dp_)
+    dop = dop.reshape(bh, tq, dp_)
+    kp = kp.reshape(bh, tk, dp_)
+    vp = vp.reshape(bh, tk, dp_)
+    mp = mp.reshape(bh, tq)
+    linvp = linvp.reshape(bh, tq)
+    dlp = dlp.reshape(bh, tq)
+    n_qb, n_kb = tq // block_q, tk // block_k
 
-    def per_qblock(carry, qb):
-        dk_pad, dv_pad = carry
-        qs = qb * block_q
-        qblk = lax.dynamic_slice_in_dim(qf, qs, block_q, 2)
-        doblk = lax.dynamic_slice_in_dim(dof, qs, block_q, 2)
-        mblk = lax.dynamic_slice_in_dim(mp, qs, block_q, 2)
-        lib = lax.dynamic_slice_in_dim(linvp, qs, block_q, 2)
-        dlt = lax.dynamic_slice_in_dim(delta, qs, block_q, 2)
-        qpos = qs + jnp.arange(block_q)
+    q_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, dp_), lambda i, j, kb: (i, kb, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j))
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
-        def inner(kb, inner_carry):
-            dqb, dk_pad, dv_pad = inner_carry
-            ks = kb * block_k
-            kblk = lax.dynamic_slice_in_dim(kpad, ks, block_k, 2)
-            vblk = lax.dynamic_slice_in_dim(vpad, ks, block_k, 2)
-            kpos = ks + jnp.arange(block_k)
-            s = jnp.einsum("bhqd,bhkd->bhqk", qblk, kblk)
-            mask = ((qpos[:, None] >= kpos[None, :])
-                    & (kpos[None, :] < t) & (qpos[:, None] < t))
-            p = jnp.where(mask,
-                          jnp.exp(s - mblk[..., None]) * lib[..., None], 0.0)
-            dvb = jnp.einsum("bhqk,bhqd->bhkd", p, doblk)
-            dp = jnp.einsum("bhqd,bhkd->bhqk", doblk, vblk)
-            ds = p * (dp - dlt[..., None])
-            dqb = dqb + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk) * scale
-            dkb = jnp.einsum("bhqk,bhqd->bhkd", ds, qblk)  # qblk carries scale
-            upd = lambda acc, blk: lax.dynamic_update_slice_in_dim(
-                acc, lax.dynamic_slice_in_dim(acc, ks, block_k, 2) + blk,
-                ks, 2)
-            return dqb, upd(dk_pad, dkb), upd(dv_pad, dvb)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
+                          t_real=t, scale=scale),
+        grid=(bh, n_qb, n_kb),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
+                  row_spec],
+        out_specs=pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, dp_), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dp_), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, mp, linvp, dlp)
 
-        # causal diagonal bound: k-blocks with ks >= qs + block_q are all-masked
-        hi = jnp.minimum(lax.div(qs + block_q + block_k - 1, block_k), n_kb)
-        dqb0 = jnp.zeros((b, h, block_q, dh), jnp.float32)
-        dqb, dk_pad, dv_pad = lax.fori_loop(
-            0, hi, inner, (dqb0, dk_pad, dv_pad))
-        return (dk_pad, dv_pad), dqb
+    # dkv grid: (bh, k-block, q-block) — index maps select by the axis kind
+    kv_spec = pl.BlockSpec((1, block_k, dp_), lambda i, j, qb: (i, j, 0))
+    qi_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, qb: (i, qb, 0))
+    rowi_spec = pl.BlockSpec((1, block_q), lambda i, j, qb: (i, qb))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
+                          t_real=t, scale=scale),
+        grid=(bh, n_kb, n_qb),
+        in_specs=[kv_spec, kv_spec, qi_spec, qi_spec, rowi_spec, rowi_spec,
+                  rowi_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dp_), lambda i, j, qb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda i, j, qb: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, dp_), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, dp_), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dp_), jnp.float32),
+            pltpu.VMEM((block_k, dp_), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=_interpret(),
+    )(kp, vp, qp, dop, mp, linvp, dlp)
 
-    dk0 = jnp.zeros_like(kpad)
-    dv0 = jnp.zeros_like(vpad)
-    (dk_pad, dv_pad), dqbs = lax.scan(per_qblock, (dk0, dv0),
-                                      jnp.arange(n_qb))
-    dq = jnp.moveaxis(dqbs, 0, 2).reshape(b, h, tqp, dh)[:, :, :t, :]
-    return (dq.astype(q.dtype), dk_pad[:, :, :t].astype(k.dtype),
-            dv_pad[:, :, :t].astype(v.dtype))
+    dq = dq.reshape(b, h, tq, dp_)[:, :, :t, :dh]
+    dk = dk.reshape(b, h, tk, dp_)[:, :, :t, :dh]
+    dv = dv.reshape(b, h, tk, dp_)[:, :, :t, :dh]
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -63,6 +63,52 @@ def test_flash_gradients_match_dense():
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("t,bq,bk", [
+    (48, 16, 16),     # T not a multiple of the block: backward padding path
+    (32, 32, 32),     # single block each way
+])
+def test_flash_gradients_match_dense_padded(t, bq, bk):
+    key = jax.random.key(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (2, 2, t, 16)
+    q = jax.random.normal(kq, shape)
+    k = jax.random.normal(kk, shape)
+    v = jax.random.normal(kv, shape)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, bq, bk) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bf16_gradients():
+    """bf16 cotangents flow through the Pallas backward (f32 accumulation)."""
+    key = jax.random.key(8)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (1, 2, 32, 16)
+    q = jax.random.normal(kq, shape).astype(jnp.bfloat16)
+    k = jax.random.normal(kk, shape).astype(jnp.bfloat16)
+    v = jax.random.normal(kv, shape).astype(jnp.bfloat16)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, 16, 16).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(_dense_reference(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    for a, b in zip(gf, gd):
+        assert a.dtype == q.dtype
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
+                                   rtol=1e-1, atol=1e-1)
+
+
 def test_flash_mha_matches_causal_attention():
     key = jax.random.key(2)
     d, h, t, b = 64, 4, 32, 2
