@@ -287,7 +287,11 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
             stages.append(Stage(apply=apply, params=params, in_shape=in_shape))
         start += per[s]
 
-    wire_dim = t_loc * max(cfg.d_model, cfg.vocab)
+    # the wire carries only INTER-stage activations ([t_loc, d_model] blocks
+    # and the stage-0 token ids); the last stage's [t_loc, vocab] log-probs
+    # are consumed locally by the engine's loss and never ride the ppermute
+    # ring, so vocab never widens the wire
+    wire_dim = t_loc * cfg.d_model
     return stages, wire_dim, (cfg.seq_len, cfg.vocab)
 
 

@@ -244,7 +244,10 @@ class Pipeline:
                     out = out[0]
                 exact_shape = out.shape
                 out_size = int(np.prod(out.shape[1:]))
-            if out_size > self.wire_dim:
+            # the last stage's output never rides the wire (its log-probs
+            # are consumed locally by the loss), so only inter-stage hops
+            # must fit wire_dim
+            if s + 1 < len(self.stages) and out_size > self.wire_dim:
                 raise ValueError(
                     f"stage {s} output width {out_size} exceeds wire_dim "
                     f"{self.wire_dim}")
@@ -362,10 +365,20 @@ class Pipeline:
 
     # ---- forward/loss ---------------------------------------------------
 
-    def _shard_fn(self, deterministic: bool) -> Callable:
-        """Build (once per mode) the shard_mapped pipeline loss function."""
-        if deterministic in self._sm_cache:
-            return self._sm_cache[deterministic]
+    def _shard_fn(self, deterministic: bool, loss_only: bool = False
+                  ) -> Callable:
+        """Build (once per mode) the shard_mapped pipeline loss function.
+
+        ``loss_only``: the training mode. The scan carry drops the
+        ``[M, mb, *out_shape]`` log-probs accumulator (for a language model
+        that is the full [B, T, V] replicated over every stage — the
+        dominant activation at scale) and the function returns just the
+        scalar loss; gradients are identical because the accumulator never
+        feeds the loss.
+        """
+        cache_key = (deterministic, loss_only)
+        if cache_key in self._sm_cache:
+            return self._sm_cache[cache_key]
 
         S = self.n_stages
         M = self.n_microbatches
@@ -405,6 +418,8 @@ class Pipeline:
             mb = x_mb.shape[1]
 
             def make_branch(s):
+                is_last = (s == S - 1)
+
                 def branch(wire, k):
                     from simple_distributed_machine_learning_tpu.parallel.tensor import (
                         grad_sync,
@@ -426,14 +441,29 @@ class Pipeline:
                     if isinstance(y, tuple):
                         y, aux = y
                         aux = aux.astype(jnp.float32)
-                    out = wire_encode(y.astype(jnp.float32), wire_dim)
+                    # the last stage's output (the log-probs) never rides the
+                    # ppermute ring: it is consumed locally by the loss, so
+                    # the wire stays inter-stage-activation wide (for a GPT
+                    # that keeps vocab-width [T, V] log-probs off the hop and
+                    # off the wire padding) and the last stage sends zeros
+                    # (stage 0 overwrites its inbox with the next injected
+                    # microbatch anyway)
+                    if is_last:
+                        out = jnp.zeros((y.shape[0], wire_dim), jnp.float32)
+                        y_out = y.astype(jnp.float32)
+                    else:
+                        out = wire_encode(y.astype(jnp.float32), wire_dim)
+                        y_out = jnp.zeros((y.shape[0],) + out_shape,
+                                          jnp.float32)
                     # uniformize branch output vma for lax.switch and the
                     # scan carry: a TP stage's psum (or an EP stage's
                     # all_gather) leaves its output less-varying than a
                     # replicated stage's. Value-identity; the transpose
                     # (psum of per-replica cotangents, each ct/n after the
                     # loss pmean) reassembles the full cotangent.
-                    return _pvary_to(out, vary_axes), _pvary_to(aux, vary_axes)
+                    return (_pvary_to(out, vary_axes),
+                            _pvary_to(aux, vary_axes),
+                            _pvary_to(y_out, vary_axes))
                 if remat:
                     return jax.checkpoint(branch)
                 return branch
@@ -442,7 +472,10 @@ class Pipeline:
             fwd = [(i, (i + 1) % S) for i in range(S)]
 
             def step(carry, t):
-                wire, num_acc, den_acc, aux_acc, logits_acc = carry
+                if loss_only:
+                    wire, num_acc, den_acc, aux_acc = carry
+                else:
+                    wire, num_acc, den_acc, aux_acc, logits_acc = carry
                 # stage 0 injects a fresh microbatch every step (clipped so the
                 # drain steps recompute-and-discard the last one — finite math,
                 # zeroed below by the validity mask).
@@ -458,15 +491,15 @@ class Pipeline:
                     lax.axis_index(DATA_AXIS))
                 if n_seq > 1:
                     k_t = jax.random.fold_in(k_t, lax.axis_index(SEQ_AXIS))
-                out, aux = lax.switch(stage, branches, wire, k_t)
+                out, aux, logits = lax.switch(stage, branches, wire, k_t)
                 m = t - stage           # microbatch index this stage is working on
                 valid = (m >= 0) & (m < M)
                 out = jnp.where(valid, out, jnp.zeros_like(out))
                 # auxiliary losses (e.g. MoE load balancing) accumulate once
                 # per (stage, valid microbatch)
                 aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
-                # last stage just produced log-probs for microbatch m
-                logits = wire_decode(out, out_shape)
+                # the last stage's branch just produced log-probs for
+                # microbatch m (zeros on every other stage)
                 is_out = valid & (stage == S - 1)
                 m_safe = jnp.clip(m, 0, M - 1)
                 tgt = lax.dynamic_index_in_dim(tgt_mb, m_safe, 0, keepdims=False)
@@ -478,25 +511,30 @@ class Pipeline:
                 per_tok = jnp.broadcast_to(wb, nll.shape)
                 num_acc = num_acc + jnp.where(is_out, jnp.sum(nll * per_tok), 0.0)
                 den_acc = den_acc + jnp.where(is_out, jnp.sum(per_tok), 0.0)
-                prev = lax.dynamic_index_in_dim(logits_acc, m_safe, 0, keepdims=False)
-                logits_acc = lax.dynamic_update_index_in_dim(
-                    logits_acc, jnp.where(is_out, logits, prev), m_safe, 0)
                 # the hop: stage s -> s+1 over ICI; autodiff transposes this
                 # into the backward s+1 -> s hop.
                 wire = lax.ppermute(out, STAGE_AXIS, fwd)
+                if loss_only:
+                    return (wire, num_acc, den_acc, aux_acc), None
+                prev = lax.dynamic_index_in_dim(logits_acc, m_safe, 0, keepdims=False)
+                logits_acc = lax.dynamic_update_index_in_dim(
+                    logits_acc, jnp.where(is_out, logits, prev), m_safe, 0)
                 return (wire, num_acc, den_acc, aux_acc, logits_acc), None
 
             # the init carry is device-uniform but the loop body makes it
             # vary over every mesh axis (params vary over stage/model/expert,
             # data over data, seq-sharded tokens over seq); pcast aligns the
             # carry types for check_vma
-            init = jax.tree.map(
-                lambda a: _pvary_to(a, vary_axes),
-                (jnp.zeros((mb, wire_dim), x_mb.dtype),
-                 jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
-                 jnp.zeros((M, mb) + out_shape, jnp.float32)))
-            (_, num, den, aux, logits_acc), _ = lax.scan(
-                step, init, jnp.arange(T))
+            init0 = (jnp.zeros((mb, wire_dim), x_mb.dtype),
+                     jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+            if not loss_only:
+                init0 += (jnp.zeros((M, mb) + out_shape, jnp.float32),)
+            init = jax.tree.map(lambda a: _pvary_to(a, vary_axes), init0)
+            carry_out, _ = lax.scan(step, init, jnp.arange(T))
+            if loss_only:
+                _, num, den, aux = carry_out
+            else:
+                _, num, den, aux, logits_acc = carry_out
 
             # weighted global mean: sum(w * nll) / sum(w), reduced over the
             # stage axis (only the last stage contributed), the data axis,
@@ -530,6 +568,8 @@ class Pipeline:
                 num = lax.pmean(num, EXPERT_AXIS)
                 den = lax.pmean(den, EXPERT_AXIS)
             loss = num / jnp.maximum(den, 1e-12) + aux
+            if loss_only:
+                return loss
             # logits stay seq-sharded (the out_spec reassembles the token
             # axis); only the stage/model/expert axes are reduced away
             logits = lax.pmean(                            # replicate last stage's
@@ -555,9 +595,10 @@ class Pipeline:
                       P(None, DATA_AXIS, seq_or_none),
                       P(None, DATA_AXIS, *tgt_tok),
                       P(None, DATA_AXIS), P()),
-            out_specs=(P(), P(None, DATA_AXIS, *tgt_tok, None)),
+            out_specs=(P() if loss_only
+                       else (P(), P(None, DATA_AXIS, *tgt_tok, None))),
         )
-        self._sm_cache[deterministic] = fn
+        self._sm_cache[cache_key] = fn
         return fn
 
     def loss_and_logits(self, buf: jax.Array, x: jax.Array, targets: jax.Array,
@@ -581,6 +622,45 @@ class Pipeline:
         (pinned by tests/test_expert_pipeline.py::
         test_weighted_loss_applies_to_nll_only).
         """
+        if self._trivial_mesh():
+            return self._fused_loss(buf, x, targets, key, deterministic,
+                                    weights)
+        xw, tgt, w = self._prep_inputs(x, targets, weights)
+        loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, w, key)
+        return loss, logits.reshape((x.shape[0],) + self.out_shape)
+
+    def loss(self, buf: jax.Array, x: jax.Array, targets: jax.Array,
+             key: jax.Array, deterministic: bool = False,
+             weights: jax.Array | None = None) -> jax.Array:
+        """Scalar loss only — the training path.
+
+        Same math as ``loss_and_logits(...)[0]`` (same RNG stream, same
+        gradients) but the engine skips the per-microbatch log-probs
+        accumulator entirely: nothing [batch, *out_shape]-sized rides the
+        scan carry or is psum'd across stages. For a language model that is
+        the difference between carrying [B, T, vocab] on every device and
+        carrying two scalars.
+        """
+        if self._trivial_mesh():
+            return self._fused_loss(buf, x, targets, key, deterministic,
+                                    weights)[0]
+        xw, tgt, w = self._prep_inputs(x, targets, weights)
+        return self._shard_fn(deterministic, loss_only=True)(
+            buf, xw, tgt, w, key)
+
+    def _trivial_mesh(self) -> bool:
+        """Degenerate single-device mesh: the pipeline IS the fused model.
+        Skip the shard_map engine — its packed-row unpack/repack costs ~10x
+        the model itself at this scale (grad of the slice/concat machinery),
+        with nothing to overlap on one device."""
+        return (self.n_stages == 1 and self.n_data == 1 and self.n_model == 1
+                and self.n_seq == 1 and self.n_expert == 1
+                and self.stages[0].shards is None
+                and self.stages[0].expert_shards is None)
+
+    def _prep_inputs(self, x, targets, weights):
+        """Host-side packing: microbatch split + wire encoding of the global
+        batch (seq-sharded wires are chunked token-major per shard)."""
         import jax.numpy as jnp
 
         M = self.n_microbatches
@@ -588,16 +668,6 @@ class Pipeline:
         if B % (M * self.n_data) != 0:
             raise ValueError(
                 f"batch {B} not divisible by microbatches*data = {M * self.n_data}")
-        if (self.n_stages == 1 and self.n_data == 1 and self.n_model == 1
-                and self.n_seq == 1 and self.n_expert == 1
-                and self.stages[0].shards is None
-                and self.stages[0].expert_shards is None):
-            # degenerate mesh: the pipeline IS the fused model. Skip the
-            # shard_map engine — its packed-row unpack/repack costs ~10x the
-            # model itself at this scale (grad of the slice/concat machinery),
-            # with nothing to overlap on one device.
-            return self._fused_loss(buf, x, targets, key, deterministic,
-                                    weights)
         # the wire is always float32 (stages decode/cast as needed — e.g. the
         # GPT embedding stage reads token ids back out of the float wire)
         if self.n_seq > 1:
@@ -620,8 +690,7 @@ class Pipeline:
         tgt = targets.reshape((M, B // M) + self.out_shape[:-1])
         w = (jnp.ones((B,), jnp.float32) if weights is None
              else weights.astype(jnp.float32)).reshape(M, B // M)
-        loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, w, key)
-        return loss, logits.reshape((B,) + self.out_shape)
+        return xw, tgt, w
 
     def _fused_loss(self, buf, x, targets, key, deterministic, weights):
         """Single-device fast path. Identical to the engine for

@@ -30,9 +30,10 @@ def make_train_step(pipe: Pipeline, opt: Optimizer):
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(buf, opt_state, x, targets, key, weights=None):
         def loss_fn(b):
-            loss, _ = pipe.loss_and_logits(b, x, targets, key,
-                                           deterministic=False, weights=weights)
-            return loss
+            # Pipeline.loss: the loss-only engine — no [batch, *out_shape]
+            # log-probs accumulator rides the scan carry during training
+            return pipe.loss(b, x, targets, key, deterministic=False,
+                             weights=weights)
         loss, grads = jax.value_and_grad(loss_fn)(buf)
         buf2, opt_state2 = opt.update(grads, opt_state, buf)
         return buf2, opt_state2, loss
@@ -40,7 +41,8 @@ def make_train_step(pipe: Pipeline, opt: Optimizer):
     return step
 
 
-def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
+def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1,
+                            pool_steps: int | None = None):
     """Returns ``step(buf, opt_state, xs, targets, key) -> (buf, opt_state, losses)``
     where ``xs``/``targets`` carry a leading ``n_steps`` axis: one compiled
     program runs ``n_steps`` optimizer steps via ``lax.scan``.
@@ -51,6 +53,12 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
     dispatch per step, dwarfing the sub-ms compute of reference-scale models.
     Scanning the whole window keeps the chip busy back-to-back — this is the
     TPU-idiomatic shape of a training loop, and what ``bench.py`` measures.
+
+    ``pool_steps``: when set, ``xs``/``targets`` are a POOL of ``P`` batches
+    rather than one per step; the scan runs ``pool_steps`` optimizer steps,
+    reading batch ``t % P`` at step ``t``. This keeps the resident input
+    footprint at ``P`` batches however long the window is (a 5000-step f32
+    MNIST window would otherwise pin ~1 GB of HBM for inputs alone).
     """
 
     from simple_distributed_machine_learning_tpu.parallel.staging import (
@@ -70,6 +78,23 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(buf, opt_state, xs, targets, key):
+        import jax.numpy as jnp
+
+        def scan_batches(body, init):
+            if pool_steps is None:
+                return jax.lax.scan(body, init, (xs, targets), unroll=unroll)
+            n_pool = xs.shape[0]
+
+            def body_pool(carry, t):
+                x = jax.lax.dynamic_index_in_dim(xs, t % n_pool, 0,
+                                                 keepdims=False)
+                tt = jax.lax.dynamic_index_in_dim(targets, t % n_pool, 0,
+                                                  keepdims=False)
+                return body(carry, (x, tt))
+
+            return jax.lax.scan(body_pool, init, jnp.arange(pool_steps),
+                                unroll=unroll)
+
         # On the degenerate single-device mesh, differentiating through the
         # packed [1, 1, P] buffer costs ~10x the model itself per scan
         # iteration (the slice/concat machinery's autodiff). Unpack params and
@@ -117,8 +142,7 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
                 p2, s2 = opt.update(grads, s, p)
                 return (p2, s2, i + 1), loss
 
-            (p2, s2, _), losses = jax.lax.scan(
-                body, (params0, state0, 0), (xs, targets), unroll=unroll)
+            (p2, s2, _), losses = scan_batches(body, (params0, state0, 0))
             # s2's "leaves" (per packed-state slot) are params-shaped trees;
             # flatten_up_to recovers them for repacking
             opt2 = jax.tree.unflatten(
@@ -131,14 +155,12 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1):
             k = jax.random.fold_in(key, i)
 
             def loss_fn(bb):
-                loss, _ = pipe.loss_and_logits(bb, x, t, k, deterministic=False)
-                return loss
+                return pipe.loss(bb, x, t, k, deterministic=False)
             loss, grads = jax.value_and_grad(loss_fn)(b)
             b2, s2 = opt.update(grads, s, b)
             return (b2, s2, i + 1), loss
 
-        (buf2, opt2, _), losses = jax.lax.scan(
-            body, (buf, opt_state, 0), (xs, targets), unroll=unroll)
+        (buf2, opt2, _), losses = scan_batches(body, (buf, opt_state, 0))
         return buf2, opt2, losses
 
     return step
