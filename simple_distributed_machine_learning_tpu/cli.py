@@ -106,6 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--profile', type=str, default=None, metavar='DIR',
                    help="capture an XProf/TensorBoard trace of the whole run "
                         "into DIR")
+    g.add_argument('--peer-timeout', type=float, default=60.0,
+                   help="multi-process dead-peer watchdog: abort with a "
+                        "nonzero exit if a peer crashes or stops "
+                        "heartbeating for this many seconds (0 disables; "
+                        "the reference hangs forever on a dead peer)")
+    g.add_argument('--heartbeat-port', type=int, default=None,
+                   help="TCP port for the dead-peer watchdog "
+                        "(default: master_port + 1)")
     return p
 
 
@@ -139,12 +147,30 @@ def main(argv: list[str] | None = None) -> None:
 
     from simple_distributed_machine_learning_tpu.parallel.mesh import (
         bootstrap_distributed,
-        make_mesh,
     )
 
     bootstrap_distributed(args.rank or 0, args.world_size,
                           args.master_addr, args.master_port)
 
+    watchdog = None
+    if args.world_size > 1 and args.peer_timeout > 0:
+        from simple_distributed_machine_learning_tpu.utils.failure import (
+            HeartbeatWatchdog,
+        )
+        hb_port = (args.heartbeat_port if args.heartbeat_port is not None
+                   else int(args.master_port) + 1)
+        watchdog = HeartbeatWatchdog(
+            args.rank or 0, args.world_size, args.master_addr, hb_port,
+            timeout=args.peer_timeout).start()
+
+    try:
+        _dispatch(args)
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+
+
+def _dispatch(args) -> None:
     n_dev = len(jax.devices())
     n_stages = args.stages if args.stages is not None else (2 if n_dev >= 2 else 1)
 
@@ -189,6 +215,7 @@ def main(argv: list[str] | None = None) -> None:
         train_ds = Dataset(train_ds.x.reshape(len(train_ds.x), -1), train_ds.y)
         test_ds = Dataset(test_ds.x.reshape(len(test_ds.x), -1), test_ds.y)
 
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
     from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
     from simple_distributed_machine_learning_tpu.train.trainer import (
         TrainConfig,

@@ -20,6 +20,21 @@ import jax
 import numpy as np
 
 
+def _to_host(x) -> np.ndarray:
+    """Bring an array to host memory, multi-host-safely.
+
+    A single-controller (or single-host) array is fully addressable and
+    ``device_get`` suffices. In a multi-process run the stage-sharded buffer's
+    shards live on OTHER processes' devices; ``process_allgather`` (a
+    collective — every process must call it) reassembles the global value on
+    every host.
+    """
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def save_checkpoint(path: str, buf: jax.Array, opt_state: Any, step: int,
                     extra: dict | None = None) -> None:
     """Write training state to ``path`` (one .npz, atomically replaced).
@@ -28,12 +43,18 @@ def save_checkpoint(path: str, buf: jax.Array, opt_state: Any, step: int,
     can never leave arrays and metadata out of sync; a human-readable
     ``path + '.meta.json'`` sidecar is written as a convenience copy and is
     not read on restore.
+
+    Multi-process: EVERY process must call this (the gather of
+    non-addressable shards is a collective); only process 0 touches the
+    filesystem.
     """
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    arrays = {"params": np.asarray(jax.device_get(buf))}
+    arrays = {"params": _to_host(buf)}
     opt_leaves, _ = jax.tree.flatten(opt_state)
     for i, leaf in enumerate(opt_leaves):
-        arrays[f"opt_{i}"] = np.asarray(jax.device_get(leaf))
+        arrays[f"opt_{i}"] = _to_host(leaf)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     meta = {"step": int(step), "n_opt_leaves": len(opt_leaves),
             "extra": extra or {}}
     arrays["_meta_json"] = np.frombuffer(

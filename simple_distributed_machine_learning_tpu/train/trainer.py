@@ -123,11 +123,10 @@ class Trainer:
         from simple_distributed_machine_learning_tpu.train.checkpoint import (
             save_checkpoint,
         )
-        # gather-on-save assumes a fully-addressable (single-controller or
-        # single-host) mesh; multi-host saves go through process 0 only
-        if self.is_main:
-            save_checkpoint(self._ckpt_path(), self.buf, self.opt_state,
-                            self._step_count, extra={"epoch": epoch})
+        # every process participates: gathering non-addressable shards is a
+        # collective inside save_checkpoint; only process 0 writes the file
+        save_checkpoint(self._ckpt_path(), self.buf, self.opt_state,
+                        self._step_count, extra={"epoch": epoch})
 
     # -- reference console surface (simple_distributed.py:114-117,:130-132) --
 

@@ -1,0 +1,221 @@
+"""Failure detection: the dead-peer watchdog (SURVEY §5.3).
+
+The reference sets ``rpc_timeout=0`` and hangs forever when a peer dies
+(``/root/reference/simple_distributed.py:36,:167``). XLA collectives inside a
+compiled step share that failure mode: a gloo/DCN send whose counterpart is
+gone never completes, and the Python main thread is blocked inside the
+runtime where no exception can reach it. The watchdog runs BESIDE the
+training loop:
+
+- rank 0 listens on a TCP port; every other rank connects and streams
+  heartbeat bytes at ``interval``;
+- a crash is detected two ways: the kernel closes a dead process's socket
+  (EOF without the goodbye byte — immediate), or heartbeats go stale for
+  ``timeout`` seconds (frozen process / severed network);
+- on detection every surviving rank writes a diagnostic to stderr and
+  hard-exits (``os._exit``) with :data:`EXIT_PEER_LOST` — the only reliable
+  way out, since the main thread may be parked inside a collective that will
+  never complete;
+- clean shutdown is protocol-distinguished: :meth:`HeartbeatWatchdog.stop`
+  sends a goodbye byte first, so a peer that finishes earlier never trips
+  the others.
+
+This turns the reference's infinite hang into a prompt, scriptable, nonzero
+exit (tests/test_multiprocess.py::test_dead_peer_aborts_rank0).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+
+EXIT_PEER_LOST = 13
+_HB = b"h"      # heartbeat byte
+_BYE = b"b"     # clean-shutdown byte
+
+
+class HeartbeatWatchdog:
+    """Dead-peer detector over a star TCP topology (rank 0 at the center).
+
+    ``start()`` after the collective rendezvous (all processes exist by
+    then); ``stop()`` before process exit. All threads are daemons; a
+    watchdog failure calls ``os._exit(EXIT_PEER_LOST)``.
+    """
+
+    def __init__(self, rank: int, world_size: int, master_addr: str,
+                 port: int, interval: float = 1.0, timeout: float = 30.0):
+        self.rank = rank
+        self.world_size = world_size
+        self.addr = master_addr
+        self.port = int(port)
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self._stopping = False
+        self._threads: list[threading.Thread] = []
+        self._server: socket.socket | None = None
+        self._client: socket.socket | None = None
+        self._last_seen: dict[int, float] = {}
+        self._said_bye: set[int] = set()
+        self._master_bye = False
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "HeartbeatWatchdog":
+        if self.world_size <= 1:
+            return self
+        if self.rank == 0:
+            self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._server.bind((self.addr, self.port))
+            self._server.listen(self.world_size)
+            self._spawn(self._accept_loop)
+            self._spawn(self._staleness_loop)
+        else:
+            self._spawn(self._client_loop)
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            if self._client is not None:
+                self._client.sendall(_BYE)
+                self._client.close()
+        except OSError:
+            pass
+        # rank 0: tell every peer this is a clean exit before closing, so a
+        # peer still mid-training doesn't read the EOF as a master crash
+        for conn in self._conns:
+            try:
+                conn.sendall(_BYE)
+                conn.close()
+            except OSError:
+                pass
+        try:
+            if self._server is not None:
+                self._server.close()
+        except OSError:
+            pass
+
+    # -- failure ----------------------------------------------------------
+
+    def _fail(self, what: str) -> None:
+        if self._stopping:
+            return
+        sys.stderr.write(
+            f"[watchdog] rank {self.rank}: {what} — aborting run "
+            f"(the reference would hang forever here; SURVEY §5.3)\n")
+        sys.stderr.flush()
+        os._exit(EXIT_PEER_LOST)
+
+    # -- rank 0: server side ----------------------------------------------
+
+    def _spawn(self, fn) -> None:
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        next_id = 0
+        while not self._stopping and next_id < self.world_size - 1:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return      # server closed by stop()
+            next_id += 1
+            peer = next_id  # connection order stands in for rank identity
+            with self._lock:
+                self._last_seen[peer] = time.monotonic()
+                self._conns.append(conn)
+            self._spawn(lambda c=conn, p=peer: self._reader(c, p))
+
+    def _reader(self, conn: socket.socket, peer: int) -> None:
+        try:
+            while True:
+                data = conn.recv(64)
+                if not data:
+                    break               # EOF: peer's socket closed
+                with self._lock:
+                    self._last_seen[peer] = time.monotonic()
+                    if _BYE in data:
+                        self._said_bye.add(peer)
+        except OSError:
+            pass
+        with self._lock:
+            graceful = peer in self._said_bye
+        if not graceful:
+            self._fail(f"peer {peer} vanished (socket closed without "
+                       f"goodbye — killed or crashed)")
+
+    def _staleness_loop(self) -> None:
+        deadline_first = time.monotonic() + self.timeout
+        while not self._stopping:
+            time.sleep(self.interval)
+            now = time.monotonic()
+            with self._lock:
+                n_connected = len(self._last_seen)
+                stale = [p for p, ts in self._last_seen.items()
+                         if p not in self._said_bye
+                         and now - ts > self.timeout]
+            if stale:
+                self._fail(f"peer(s) {stale} stopped heartbeating for "
+                           f">{self.timeout:.0f}s (frozen or unreachable)")
+            if (n_connected < self.world_size - 1
+                    and now > deadline_first):
+                self._fail(
+                    f"only {n_connected}/{self.world_size - 1} peers "
+                    f"connected their heartbeat within {self.timeout:.0f}s")
+
+    # -- rank > 0: client side --------------------------------------------
+
+    def _client_loop(self) -> None:
+        deadline = time.monotonic() + self.timeout
+        sock = None
+        while not self._stopping:
+            try:
+                sock = socket.create_connection((self.addr, self.port),
+                                                timeout=self.interval)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    self._fail(f"could not reach rank 0's heartbeat port "
+                               f"{self.addr}:{self.port} within "
+                               f"{self.timeout:.0f}s")
+                    return
+                time.sleep(0.2)
+        if sock is None:
+            return
+        self._client = sock
+        # rank 0 never writes; a recv returning EOF means its socket died.
+        # Watch for that in a side thread while the main loop heartbeats.
+        self._spawn(lambda: self._watch_master(sock))
+        while not self._stopping:
+            try:
+                sock.sendall(_HB)
+            except OSError:
+                # a send failure AFTER rank 0's goodbye is just the socket
+                # draining post-exit — not a peer loss
+                if not self._master_bye:
+                    self._fail("rank 0 unreachable (heartbeat send failed)")
+                return
+            time.sleep(self.interval)
+
+    def _watch_master(self, sock: socket.socket) -> None:
+        while True:
+            try:
+                data = sock.recv(64)
+            except OSError:
+                return
+            if _BYE in data:
+                self._master_bye = True   # clean exit: sends may now fail
+                return
+            if not data:
+                if not self._stopping:
+                    self._fail("rank 0 closed the heartbeat channel "
+                               "without goodbye")
+                return

@@ -28,7 +28,10 @@ def _free_port() -> int:
 
 
 def _launch_rank(rank: int, port: int, extra: list[str],
-                 env_extra: dict | None = None) -> subprocess.Popen:
+                 env_extra: dict | None = None, world_size: int = 2,
+                 hb_port: int | None = None,
+                 stdout=subprocess.PIPE, stderr=subprocess.PIPE
+                 ) -> subprocess.Popen:
     env = os.environ.copy()
     env["JAX_PLATFORMS"] = "cpu"
     # one local device per process: the whole point is crossing a REAL
@@ -36,27 +39,35 @@ def _launch_rank(rank: int, port: int, extra: list[str],
     env.pop("XLA_FLAGS", None)
     env.update(env_extra or {})
     cmd = [sys.executable, "-m", "simple_distributed_machine_learning_tpu.cli",
-           "--rank", str(rank), "--world_size", "2",
+           "--rank", str(rank), "--world_size", str(world_size),
            "--master_addr", "localhost", "--master_port", str(port), *extra]
-    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True, env=env,
-                            cwd=REPO)
+    if hb_port is not None:
+        cmd += ["--heartbeat-port", str(hb_port)]
+    return subprocess.Popen(cmd, stdout=stdout, stderr=stderr, text=True,
+                            env=env, cwd=REPO)
+
+
+def run_ranks(extra: list[str], timeout: int = 420, world_size: int = 2
+              ) -> tuple[subprocess.CompletedProcess, ...]:
+    port, hb_port = _free_port(), _free_port()
+    procs = [_launch_rank(r, port, extra, world_size=world_size,
+                          hb_port=hb_port) for r in range(world_size)]
+    results = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            results.append(
+                subprocess.CompletedProcess(p.args, p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return tuple(results)
 
 
 def run_two_ranks(extra: list[str], timeout: int = 420
                   ) -> tuple[subprocess.CompletedProcess, ...]:
-    port = _free_port()
-    p0 = _launch_rank(0, port, extra)
-    p1 = _launch_rank(1, port, extra)
-    try:
-        out0, err0 = p0.communicate(timeout=timeout)
-        out1, err1 = p1.communicate(timeout=timeout)
-    finally:
-        for p in (p0, p1):
-            if p.poll() is None:
-                p.kill()
-    return (subprocess.CompletedProcess(p0.args, p0.returncode, out0, err0),
-            subprocess.CompletedProcess(p1.args, p1.returncode, out1, err1))
+    return run_ranks(extra, timeout=timeout, world_size=2)
 
 
 def test_two_process_launch_trains_and_rank0_prints(tmp_path):
@@ -90,3 +101,97 @@ def test_two_process_launch_reference_workload_lenet(tmp_path):
     assert "Train Epoch: 1" in r0.stdout
     assert "Test set: Average loss:" in r0.stdout
     assert "Train Epoch" not in r1.stdout
+
+
+def test_dead_peer_aborts_rank0(tmp_path):
+    """SURVEY §5.3: kill rank 1 mid-run; rank 0 must exit nonzero promptly
+    instead of hanging forever inside a collective (the reference hangs:
+    rpc_timeout=0, simple_distributed.py:36,167)."""
+    import signal
+    import time
+
+    port, hb_port = _free_port(), _free_port()
+    out_path = tmp_path / "r0.log"
+    extra = ["--model", "mlp", "--mlp-dims", "784,64,10",
+             "--epochs", "500",                 # far more work than we allow
+             "--data-root", str(tmp_path / "nodata"),
+             "--peer-timeout", "15"]
+    with open(out_path, "w") as f0:
+        p0 = _launch_rank(0, port, extra, hb_port=hb_port,
+                          stdout=f0, stderr=subprocess.STDOUT)
+        p1 = _launch_rank(1, port, extra, hb_port=hb_port,
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL)
+        try:
+            # wait until training is actually underway on rank 0
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                if p0.poll() is not None:
+                    raise AssertionError(
+                        f"rank0 exited early:\n{out_path.read_text()[-3000:]}")
+                if "Train Epoch" in out_path.read_text():
+                    break
+                time.sleep(1.0)
+            else:
+                raise AssertionError("training never started")
+            p1.send_signal(signal.SIGKILL)
+            rc = p0.wait(timeout=120)
+        finally:
+            for p in (p0, p1):
+                if p.poll() is None:
+                    p.kill()
+    assert rc not in (0, None), "rank 0 must fail once its peer is gone"
+    assert "watchdog" in out_path.read_text(), \
+        f"expected a watchdog diagnostic:\n{out_path.read_text()[-2000:]}"
+
+
+def test_checkpoint_resume_across_restart_bit_exact(tmp_path):
+    """Multi-process checkpointing end to end: a 2-process run that is
+    stopped after epoch 1 and relaunched must resume (not restart) and land
+    on the BIT-EXACT state a straight-through 2-epoch run produces — the
+    gather inside save_checkpoint is a collective both processes drive, and
+    restore must reload step count and RNG position exactly."""
+    import numpy as np
+
+    common = ["--model", "mlp", "--mlp-dims", "784,64,10",
+              "--data-root", str(tmp_path / "nodata")]
+
+    dir_a = str(tmp_path / "ckpt_straight")
+    r0, r1 = run_two_ranks(common + ["--epochs", "2",
+                                     "--checkpoint-dir", dir_a])
+    assert r0.returncode == 0, f"straight run failed:\n{r0.stderr[-3000:]}"
+
+    dir_b = str(tmp_path / "ckpt_resumed")
+    r0, r1 = run_two_ranks(common + ["--epochs", "1",
+                                     "--checkpoint-dir", dir_b])
+    assert r0.returncode == 0, f"first leg failed:\n{r0.stderr[-3000:]}"
+    r0, r1 = run_two_ranks(common + ["--epochs", "2",
+                                     "--checkpoint-dir", dir_b])
+    assert r0.returncode == 0, f"resumed leg failed:\n{r0.stderr[-3000:]}"
+    assert "resumed from" in r0.stdout
+    # resumed run trains ONLY epoch 2
+    assert "Train Epoch: 2" in r0.stdout
+    assert "Train Epoch: 1" not in r0.stdout
+
+    za = np.load(os.path.join(dir_a, "state.npz"))
+    zb = np.load(os.path.join(dir_b, "state.npz"))
+    assert np.array_equal(za["params"], zb["params"]), \
+        "resumed params differ from the straight-through run"
+    assert np.array_equal(za["opt_0"], zb["opt_0"]), \
+        "resumed optimizer state differs from the straight-through run"
+
+
+def test_four_process_dp_pp(tmp_path):
+    """world_size=4: a dp=2 x pp=2 mesh over four OS processes (one CPU
+    device each) completes an epoch with rank-0-only printing."""
+    rs = run_ranks([
+        "--model", "mlp", "--mlp-dims", "784,64,10", "--epochs", "1",
+        "--stages", "2", "--dp", "2", "--microbatches", "2",
+        "--data-root", str(tmp_path / "nodata"),
+    ], timeout=560, world_size=4)
+    assert rs[0].returncode == 0, f"rank0 failed:\n{rs[0].stderr[-3000:]}"
+    for r in rs[1:]:
+        assert r.returncode == 0, f"peer failed:\n{r.stderr[-3000:]}"
+        assert "Train Epoch" not in r.stdout
+    assert "Train Epoch: 1" in rs[0].stdout
+    assert "Test set: Average loss:" in rs[0].stdout
