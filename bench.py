@@ -1,25 +1,45 @@
-"""Headline benchmark: samples/sec/chip on the 2-stage MLP pipeline.
+"""Benchmarks: samples/sec/chip + MFU for every BASELINE.json config.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Default invocation prints ONE JSON line (the headline config — the 2-stage
+MLP of BASELINE.json configs 1-2):
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}
 
-Config (BASELINE.json configs 1-2): 2-layer MLP 784-512-10 (stage0=fc1,
-stage1=fc2), batch 60 (the reference's batch size, simple_distributed.py:18),
-SGD(lr=0.1, momentum=0.5), random tensors. The measured run uses the
-epoch-compiled train step (lax.scan over batches) — one dispatch per window,
-so the number reflects chip throughput, not host/tunnel dispatch latency.
+``--all`` additionally measures the 4-stage MLP (config 3), LeNet (config 4),
+the tiny GPipe GPT (config 5), and a bf16 GPT sized to load the MXU, printing
+one JSON line per row and writing ``benchmarks/results_all.json``.
+
+Measurement: the epoch-compiled train step (``lax.scan`` over batches) with a
+small resident POOL of input batches (``pool_steps`` in
+``train/step.py``) — one dispatch per window, so the number reflects chip
+throughput, not host/tunnel dispatch latency, without pinning GBs of inputs.
+Two-point timing (one window vs two back-to-back windows, each closed with a
+forced host read) cancels every fixed cost: dispatch, tunnel round-trip, the
+host read.
+
+MFU: closed-form training FLOPs (fwd matmul FLOPs x3 — the standard
+approximation; backward costs 2x forward) divided by the chip's peak. Peaks
+are the published bf16 matmul numbers per device kind; f32 rows are still
+divided by the bf16 peak (TPU MXUs execute f32 matmuls via bf16 passes at
+default precision), so f32 MFU is an honest "fraction of the chip" figure.
 
 ``vs_baseline`` divides by the stored CPU baseline (benchmarks/
 baseline_cpu.json): the torch.distributed.rpc 2-process CPU implementation of
 the same workload (the reference's architecture, measured by
 benchmarks/torch_rpc_baseline.py) — i.e. "ours on TPU vs theirs on CPU",
-which is the north-star comparison. Regenerate baselines with
-``python bench.py --measure-baseline``.
+which is the north-star comparison (BASELINE.json config 1 vs 2). Regenerate
+with ``python bench.py --measure-baseline``.
+
+Single-chip note: with one device the pipeline degenerates to the fused
+single-stage model (``Pipeline.loss_and_logits``'s fast path) — the same
+math, no ppermute. The multi-stage shard_map engine is covered on virtual
+CPU meshes (tests/) and by the driver's ``dryrun_multichip``; its on-chip
+throughput needs >=2 real chips, which this environment does not have.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import subprocess
@@ -28,51 +48,178 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_PATH = os.path.join(REPO, "benchmarks", "baseline_cpu.json")
+RESULTS_PATH = os.path.join(REPO, "benchmarks", "results_all.json")
 
-DIMS = [784, 512, 10]
-BATCH = 60
-N_MICRO = 1          # reference schedule: one microbatch
-# steps per compiled scan window: large enough that one window is tens of ms
-# of chip time — per-dispatch latency (ms-scale through a remote-chip tunnel)
-# must not dominate the measurement
-SCAN_STEPS = 5000
-WINDOWS = 5
+# published peak bf16 matmul FLOP/s per chip, by jax device_kind
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,      # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,      # v6e / Trillium
+}
+
+POOL = 16                       # resident input batches per window
 
 
-def measure_pipeline_sps(scan_steps: int = SCAN_STEPS,
-                         windows: int = WINDOWS) -> dict:
+def _mlp_flops(dims):
+    """Per-sample training FLOPs of an MLP: 3 x fwd, fwd = 2*sum(d_i*d_i+1)."""
+    return 6 * sum(dims[i] * dims[i + 1] for i in range(len(dims) - 1))
+
+
+def _lenet_flops():
+    """LeNet per-sample training FLOPs (convs dominate; pools/bias dropped).
+
+    conv1 1->10 k5 on 28x28 (out 24x24), conv2 10->20 k5 on 12x12 (out 8x8),
+    fc 320->50->10 — the reference's exact architecture
+    (/root/reference/simple_distributed.py:26-95).
+    """
+    conv1 = 2 * 24 * 24 * 10 * (5 * 5 * 1)
+    conv2 = 2 * 8 * 8 * 20 * (5 * 5 * 10)
+    fc = 2 * (320 * 50 + 50 * 10)
+    return 3 * (conv1 + conv2 + fc)
+
+
+def _gpt_flops(cfg):
+    """Per-sample training FLOPs of the GPT (3 x fwd matmul FLOPs).
+
+    Per token per layer: qkvo projections 8d^2, attention scores+values 4Td,
+    MLP (ratio r) 2*2*r*d^2; head 2dV per token. Causal masking's 2x saving
+    on the score matmuls is NOT credited (XLA computes the full product).
+    """
+    d, T, L, V, r = (cfg.d_model, cfg.seq_len, cfg.n_layers, cfg.vocab,
+                     cfg.mlp_ratio)
+    per_tok = L * (8 * d * d + 4 * T * d + 4 * r * d * d) + 2 * d * V
+    return 3 * T * per_tok
+
+
+def _build_mlp(dims, n_dev):
+    import jax
+
+    from simple_distributed_machine_learning_tpu.models.mlp import (
+        make_mlp_stages,
+    )
+    want = len(dims) - 1
+    # degrade gracefully: as many pipeline stages as there are devices
+    # (still a real multi-stage pipeline on 2-3 chips, fused only on 1);
+    # n_chips in the output row records what actually ran
+    n_stages = want if n_dev >= want else (2 if n_dev >= 2 else 1)
+    stages, wire_dim, out_dim = make_mlp_stages(jax.random.key(0), dims,
+                                                n_stages)
+    return stages, wire_dim, out_dim, n_stages
+
+
+def _data_mlp(dims, batch, pool):
+    import jax
+    key = jax.random.key(1)
+    xs = jax.random.normal(key, (pool, batch, dims[0]))
+    ts = jax.random.randint(key, (pool, batch), 0, dims[-1])
+    return xs, ts
+
+
+def _data_img(batch, pool):
+    import jax
+    key = jax.random.key(1)
+    xs = jax.random.normal(key, (pool, batch, 28, 28, 1))
+    ts = jax.random.randint(key, (pool, batch), 0, 10)
+    return xs, ts
+
+
+def _data_gpt(cfg, batch, pool):
+    import jax
+    key = jax.random.key(1)
+    xs = jax.random.randint(key, (pool, batch, cfg.seq_len), 0,
+                            cfg.vocab).astype("float32")
+    ts = jax.random.randint(jax.random.key(2), (pool, batch, cfg.seq_len), 0,
+                            cfg.vocab)
+    return xs, ts
+
+
+def _configs():
+    """name -> spec. Built lazily so jax only imports inside measure()."""
+    from simple_distributed_machine_learning_tpu.models.gpt import GPTConfig
+
+    mlp2 = [784, 512, 10]
+    mlp4 = [784, 512, 512, 512, 10]
+    tiny_gpt = GPTConfig(vocab=128, seq_len=64, d_model=128, n_heads=4,
+                         n_layers=2)
+    big_gpt = GPTConfig(vocab=8192, seq_len=256, d_model=512, n_heads=8,
+                        n_layers=4)
+    return {
+        # BASELINE.json config 2 (headline; config 1 is the torch-RPC CPU
+        # baseline of the same workload)
+        # steps are sized so one compiled window is >= ~200 ms of chip time:
+        # the axon tunnel's dispatch jitter is ~10 ms, so shorter windows
+        # drown the signal (observed: a 25 ms window made MFU read >1.0)
+        "mlp2": dict(kind="mlp", dims=mlp2, batch=60, n_micro=1,
+                     steps=30000, flops=_mlp_flops(mlp2), dtype=None),
+        # config 3: 4-layer MLP -> 4-stage pipeline, microbatch=1
+        "mlp4": dict(kind="mlp", dims=mlp4, batch=60, n_micro=1,
+                     steps=20000, flops=_mlp_flops(mlp4), dtype=None),
+        # config 4: LeNet split conv<->fc (the reference's own workload)
+        "lenet": dict(kind="lenet", batch=60, n_micro=1, steps=4000,
+                      flops=_lenet_flops(), dtype=None),
+        # config 5: 2-layer tiny-GPT (d=128) with GPipe microbatching
+        "gpt": dict(kind="gpt", cfg=tiny_gpt, batch=32, n_micro=4,
+                    steps=1000, flops=_gpt_flops(tiny_gpt), dtype=None),
+        # MXU-sized bf16 GPT: the MFU row (not a BASELINE config; sized so
+        # the matmuls are large enough for the systolic array to matter)
+        "gpt_bf16": dict(kind="gpt", cfg=big_gpt, batch=16, n_micro=1,
+                         steps=100, flops=_gpt_flops(big_gpt),
+                         dtype="bfloat16"),
+        "mlp2_bf16": dict(kind="mlp", dims=mlp2, batch=60, n_micro=1,
+                          steps=15000, flops=_mlp_flops(mlp2),
+                          dtype="bfloat16"),
+    }
+
+
+def measure(name: str, spec: dict, windows: int = 5) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages
     from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
-    from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
     from simple_distributed_machine_learning_tpu.train.optimizer import sgd
     from simple_distributed_machine_learning_tpu.train.step import (
         make_scanned_train_step,
     )
 
     n_dev = len(jax.devices())
-    n_stages = 2 if n_dev >= 2 else 1
-    mesh = make_mesh(n_stages=n_stages, n_data=1)
+    batch, n_micro = spec["batch"], spec["n_micro"]
+    steps = spec.get("steps_override") or spec["steps"]
 
-    key = jax.random.key(0)
-    stages, wire_dim, out_dim = make_mlp_stages(key, DIMS, n_stages)
-    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=N_MICRO)
+    if spec["kind"] == "mlp":
+        stages, wire_dim, out_dim, n_stages = _build_mlp(spec["dims"], n_dev)
+        xs, ts = _data_mlp(spec["dims"], batch, POOL)
+    elif spec["kind"] == "lenet":
+        from simple_distributed_machine_learning_tpu.models.lenet import (
+            make_lenet_stages,
+        )
+        n_stages = 2 if n_dev >= 2 else 1
+        stages, wire_dim, out_dim = make_lenet_stages(jax.random.key(0),
+                                                      n_stages)
+        xs, ts = _data_img(batch, POOL)
+    else:
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            make_gpt_stages,
+        )
+        cfg = spec["cfg"]
+        n_stages = 2 if n_dev >= 2 else 1
+        stages, wire_dim, out_dim = make_gpt_stages(jax.random.key(0), cfg,
+                                                    n_stages)
+        xs, ts = _data_gpt(cfg, batch, POOL)
+
+    mesh = make_mesh(n_stages=n_stages, n_data=1)
+    dtype = jnp.bfloat16 if spec["dtype"] == "bfloat16" else None
+    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=n_micro,
+                    compute_dtype=dtype)
     buf = pipe.init_params()
     opt = sgd(0.1, momentum=0.5)
     opt_state = opt.init(buf)
-    step = make_scanned_train_step(pipe, opt)
-
-    # Two-point measurement: time ONE dispatch of the compiled N-step window
-    # vs TWO back-to-back dispatches (the second chains on the first through
-    # the donated buffers), each closed with a FORCED host read of the final
-    # loss — block_until_ready alone does not reliably block on remote-tunnel
-    # backends. The difference cancels every fixed cost (dispatch, tunnel
-    # round-trip, the host read) and leaves pure chip time for N steps, with
-    # one compilation and one input buffer.
-    xs = jax.random.normal(key, (scan_steps, BATCH, DIMS[0]))
-    ts = jax.random.randint(key, (scan_steps, BATCH), 0, DIMS[-1])
+    step = make_scanned_train_step(pipe, opt, pool_steps=steps)
+    key = jax.random.key(0)
     jax.block_until_ready((xs, ts))
 
     def timed(reps, buf, opt_state):
@@ -84,26 +231,40 @@ def measure_pipeline_sps(scan_steps: int = SCAN_STEPS,
         return time.perf_counter() - t0, final_loss, buf, opt_state
 
     _, _, buf, opt_state = timed(1, buf, opt_state)          # compile + warm
-    t1 = t2 = float("inf")
+    # paired two-point windows: (3 dispatches - 1 dispatch)/2 cancels every
+    # fixed cost (dispatch, tunnel round-trip, the host read) within the SAME
+    # pair; the median over pairs rejects tunnel-jitter outliers (taking
+    # separate mins of t1/t2 across windows is biased when jitter ~ window)
+    diffs = []
     for _ in range(windows):
-        dt, final_loss, buf, opt_state = timed(1, buf, opt_state)
-        t1 = min(t1, dt)
-        dt, final_loss, buf, opt_state = timed(2, buf, opt_state)
-        t2 = min(t2, dt)
-    if t2 - t1 <= 0:
+        d1, final_loss, buf, opt_state = timed(1, buf, opt_state)
+        d3, final_loss, buf, opt_state = timed(3, buf, opt_state)
+        diffs.append((d3 - d1) / 2)
+    diffs.sort()
+    dt = diffs[len(diffs) // 2]
+    if dt <= 0:
         raise RuntimeError(
-            f"two-point timing collapsed (t1={t1:.4f}s, t2={t2:.4f}s): "
-            f"dispatch noise exceeds one {scan_steps}-step window of chip "
-            f"time — raise --steps")
-    best = scan_steps * BATCH / (t2 - t1)
+            f"{name}: two-point timing collapsed (median diff {dt:.4f}s) - "
+            f"dispatch noise exceeds one {steps}-step window; raise --steps")
+    sps = steps * batch / dt
 
-    n_chips = n_stages  # chips participating in the pipeline
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind)
+    achieved = sps * spec["flops"]     # aggregate FLOP/s across the pipeline
     return {
-        "samples_per_sec": best,
-        "samples_per_sec_per_chip": best / n_chips,
-        "n_chips": n_chips,
+        "config": name,
+        "samples_per_sec": round(sps, 1),
+        "samples_per_sec_per_chip": round(sps / n_stages, 1),
+        "n_chips": n_stages,
+        "dtype": spec["dtype"] or "float32",
+        "flops_per_sample": spec["flops"],
+        "achieved_tflops": round(achieved / 1e12, 2),
+        # model-FLOPs utilization of the chips that ran: aggregate FLOP/s
+        # over aggregate peak
+        "mfu": round(achieved / (n_stages * peak), 4) if peak else None,
+        "device_kind": kind,
         "backend": jax.default_backend(),
-        "final_loss": final_loss,
+        "final_loss": round(final_loss, 4),
     }
 
 
@@ -114,8 +275,9 @@ def _measure_jax_cpu_baseline() -> float:
         "import jax; jax.config.update('jax_platforms','cpu');"
         "jax.config.update('jax_num_cpu_devices',2);"
         "import sys; sys.path.insert(0, %r);"
-        "from bench import measure_pipeline_sps;"
-        "import json; print('RESULT'+json.dumps(measure_pipeline_sps()))"
+        "from bench import measure, _configs;"
+        "import json; spec = dict(_configs()['mlp2'], steps_override=2000);"
+        "print('RESULT'+json.dumps(measure('mlp2', spec, windows=2)))"
         % REPO)
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=600, cwd=REPO)
@@ -141,7 +303,14 @@ def main() -> None:
     ap.add_argument("--measure-baseline", action="store_true",
                     help="re-measure CPU baselines and rewrite "
                          "benchmarks/baseline_cpu.json")
-    ap.add_argument("--steps", type=int, default=SCAN_STEPS)
+    ap.add_argument("--all", action="store_true",
+                    help="measure every config, one JSON line each, and "
+                         "write benchmarks/results_all.json")
+    ap.add_argument("--config", default="mlp2", choices=list(_configs()),
+                    help="single config to measure (default: headline mlp2)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="override the per-config scan-window length (use "
+                         "when dispatch noise exceeds the window)")
     args = ap.parse_args()
 
     if args.measure_baseline or not os.path.exists(BASELINE_PATH):
@@ -159,16 +328,38 @@ def main() -> None:
     else:
         with open(BASELINE_PATH) as f:
             baselines = json.load(f)
-
-    res = measure_pipeline_sps(scan_steps=args.steps)
     base = baselines.get("torch_rpc_cpu_samples_per_sec") or \
         baselines.get("jax_cpu_pipeline_samples_per_sec")
-    print(json.dumps({
-        "metric": "2stage_mlp_pipeline_samples_per_sec_per_chip",
-        "value": round(res["samples_per_sec_per_chip"], 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(res["samples_per_sec"] / base, 2) if base else None,
-    }))
+
+    configs = _configs()
+    names = list(configs) if args.all else [args.config]
+    rows = []
+    for name in names:
+        spec = (dict(configs[name], steps_override=args.steps)
+                if args.steps else configs[name])
+        res = measure(name, spec)
+        # vs_baseline only for the headline: the torch-RPC baseline runs the
+        # 2-stage MLP workload, not the others
+        vs = (round(res["samples_per_sec"] / base, 2)
+              if base and name in ("mlp2", "mlp2_bf16") else None)
+        rows.append(dict(res, vs_baseline=vs))
+        print(json.dumps({
+            "metric": f"{name}_samples_per_sec_per_chip"
+                      if name != "mlp2" else
+                      "2stage_mlp_pipeline_samples_per_sec_per_chip",
+            "value": res["samples_per_sec_per_chip"],
+            "unit": "samples/sec/chip",
+            "vs_baseline": vs,
+            "mfu": res["mfu"],
+            "achieved_tflops": res["achieved_tflops"],
+            "dtype": res["dtype"],
+            "n_chips": res["n_chips"],
+        }))
+    if args.all:
+        with open(RESULTS_PATH, "w") as f:
+            json.dump({"device": rows[0]["device_kind"],
+                       "backend": rows[0]["backend"],
+                       "rows": rows}, f, indent=2)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,109 @@
+"""On-chip flash-vs-dense attention timing: fwd+bwd at long T.
+
+Justifies the Pallas kernel (ops/flash_attention.py) with a measured number:
+at T >= 1k the fused kernel beats XLA's dense causal attention (which
+materializes the [T, T] score matrix in fwd AND bwd) on both time and HBM.
+
+Prints one JSON line per (T, dtype) row:
+    {"t": ..., "dtype": ..., "dense_ms": ..., "flash_ms": ..., "speedup": ...}
+and writes benchmarks/flash_timing.json.
+
+Run on the TPU: python benchmarks/flash_timing.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "flash_timing.json")
+
+B, H, DH = 4, 8, 64
+ROWS = [(1024, "float32"), (1024, "bfloat16"),
+        (2048, "float32"), (2048, "bfloat16"),
+        (4096, "bfloat16")]
+REPS = 20
+
+
+def _dense_core(q, k, v):
+    """XLA dense causal attention (the ops/attention.py math)."""
+    from simple_distributed_machine_learning_tpu.ops.attention import (
+        causal_attention_core,
+    )
+    return causal_attention_core(q, k, v)
+
+
+def _time(fn, *args) -> float:
+    """Best-of wall time for one compiled call, synced via block_until_ready
+    + a forced host read (remote-tunnel-safe, like bench.py)."""
+    out = fn(*args)                      # compile + warm
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        # force a host read of one element to close the tunnel round-trip
+        float(jax.tree.leaves(out)[0].ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / REPS)
+    return best * 1e3                    # ms
+
+
+def main() -> None:
+    import sys
+    sys.path.insert(0, REPO)
+    from simple_distributed_machine_learning_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+
+    rows = []
+    for t, dtype in ROWS:
+        key = jax.random.key(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (B, H, t, DH)
+        dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        q = jax.random.normal(kq, shape).astype(dt)
+        k = jax.random.normal(kk, shape).astype(dt)
+        v = jax.random.normal(kv, shape).astype(dt)
+
+        def fwd_bwd(attn, q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+            l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return l, g
+
+        dense = jax.jit(functools.partial(fwd_bwd, _dense_core))
+        flash = jax.jit(functools.partial(fwd_bwd, flash_attention))
+
+        # parity first: the timing is meaningless if the values diverge
+        ld, gd = dense(q, k, v)
+        lf, gf = flash(q, k, v)
+        rel = abs(float(ld) - float(lf)) / max(abs(float(ld)), 1e-9)
+        assert rel < (5e-2 if dtype == "bfloat16" else 1e-3), \
+            f"T={t} {dtype}: loss mismatch dense={float(ld)} flash={float(lf)}"
+
+        dense_ms = _time(dense, q, k, v)
+        flash_ms = _time(flash, q, k, v)
+        row = {"t": t, "dtype": dtype, "b": B, "h": H, "dh": DH,
+               "dense_ms": round(dense_ms, 3),
+               "flash_ms": round(flash_ms, 3),
+               "speedup": round(dense_ms / flash_ms, 2),
+               "device": jax.devices()[0].device_kind}
+        rows.append(row)
+        print(json.dumps(row))
+
+    with open(OUT, "w") as f:
+        json.dump(rows, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
