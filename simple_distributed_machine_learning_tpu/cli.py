@@ -155,19 +155,30 @@ def main(argv: list[str] | None = None) -> None:
     watchdog = None
     if args.world_size > 1 and args.peer_timeout > 0:
         from simple_distributed_machine_learning_tpu.utils.failure import (
-            HeartbeatWatchdog,
+            spawn_watchdog,
         )
         hb_port = (args.heartbeat_port if args.heartbeat_port is not None
                    else int(args.master_port) + 1)
-        watchdog = HeartbeatWatchdog(
+        # a SUBPROCESS, not threads: in-process watchdog threads freeze when
+        # the main thread blocks in a native collective holding the GIL
+        # (utils/failure.py module docstring)
+        watchdog = spawn_watchdog(
             args.rank or 0, args.world_size, args.master_addr, hb_port,
-            timeout=args.peer_timeout).start()
+            timeout=args.peer_timeout)
 
     try:
         _dispatch(args)
-    finally:
+    except BaseException:
+        # crash path: kill the monitor abruptly (no goodbye — peers must
+        # read the disconnect as a failure) and disarm its kill_parent, so
+        # a programmatic main() caller that catches this exception is not
+        # SIGKILLed by an orphaned monitor minutes later
         if watchdog is not None:
-            watchdog.stop()
+            watchdog.abort()
+        raise
+    # goodbye ONLY on success
+    if watchdog is not None:
+        watchdog.stop()
 
 
 def _dispatch(args) -> None:

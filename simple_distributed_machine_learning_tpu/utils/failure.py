@@ -22,12 +22,24 @@ training loop:
 
 This turns the reference's infinite hang into a prompt, scriptable, nonzero
 exit (tests/test_multiprocess.py::test_dead_peer_aborts_rank0).
+
+**Why a subprocess** (:func:`spawn_watchdog`, what the CLI uses): a Python
+thread only runs when it can take the GIL, and a rank whose main thread is
+parked inside a native collective that blocks WITH the GIL held (observed
+with gloo sends on the CPU backend) freezes every in-process thread — the
+watchdog included. The spawned monitor is a separate stdlib-only process
+(no jax import — its env disables the sitecustomize TPU plugin hook), so it
+keeps running no matter what the trainer process is doing, and on failure it
+SIGTERMs (then SIGKILLs) the trainer. In-process
+:class:`HeartbeatWatchdog` remains the protocol engine and is what the
+subprocess runs internally.
 """
 
 from __future__ import annotations
 
 import os
 import socket
+import subprocess
 import sys
 import threading
 import time
@@ -35,6 +47,13 @@ import time
 EXIT_PEER_LOST = 13
 _HB = b"h"      # heartbeat byte
 _BYE = b"b"     # clean-shutdown byte
+
+
+def _abort_message(rank: int, what: str) -> str:
+    """The one diagnostic format both the in-process and subprocess paths
+    emit — tests/test_multiprocess.py greps for 'aborting run'."""
+    return (f"[watchdog] rank {rank}: {what} — aborting run "
+            f"(the reference would hang forever here; SURVEY §5.3)\n")
 
 
 class HeartbeatWatchdog:
@@ -46,13 +65,17 @@ class HeartbeatWatchdog:
     """
 
     def __init__(self, rank: int, world_size: int, master_addr: str,
-                 port: int, interval: float = 1.0, timeout: float = 30.0):
+                 port: int, interval: float = 1.0, timeout: float = 30.0,
+                 fail_handler=None):
         self.rank = rank
         self.world_size = world_size
         self.addr = master_addr
         self.port = int(port)
         self.interval = float(interval)
         self.timeout = float(timeout)
+        # tests inject a recorder; production hard-exits (os._exit is the
+        # only way out of a main thread parked inside a dead collective)
+        self._fail_handler = fail_handler
         self._stopping = False
         self._threads: list[threading.Thread] = []
         self._server: socket.socket | None = None
@@ -68,6 +91,10 @@ class HeartbeatWatchdog:
     def start(self) -> "HeartbeatWatchdog":
         if self.world_size <= 1:
             return self
+        sys.stderr.write(f"[watchdog] rank {self.rank}: started "
+                         f"({self.addr}:{self.port}, timeout "
+                         f"{self.timeout:.0f}s)\n")
+        sys.stderr.flush()
         if self.rank == 0:
             self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -79,11 +106,15 @@ class HeartbeatWatchdog:
             self._spawn(self._client_loop)
         return self
 
-    def stop(self) -> None:
+    def stop(self, goodbye: bool = True) -> None:
+        """``goodbye=False`` closes abruptly (no _BYE): used when the
+        process being monitored CRASHED — peers must read the disconnect as
+        a failure, not a clean exit."""
         self._stopping = True
         try:
             if self._client is not None:
-                self._client.sendall(_BYE)
+                if goodbye:
+                    self._client.sendall(_BYE)
                 self._client.close()
         except OSError:
             pass
@@ -91,7 +122,8 @@ class HeartbeatWatchdog:
         # peer still mid-training doesn't read the EOF as a master crash
         for conn in self._conns:
             try:
-                conn.sendall(_BYE)
+                if goodbye:
+                    conn.sendall(_BYE)
                 conn.close()
             except OSError:
                 pass
@@ -106,9 +138,10 @@ class HeartbeatWatchdog:
     def _fail(self, what: str) -> None:
         if self._stopping:
             return
-        sys.stderr.write(
-            f"[watchdog] rank {self.rank}: {what} — aborting run "
-            f"(the reference would hang forever here; SURVEY §5.3)\n")
+        if self._fail_handler is not None:
+            self._fail_handler(what)
+            return
+        sys.stderr.write(_abort_message(self.rank, what))
         sys.stderr.flush()
         os._exit(EXIT_PEER_LOST)
 
@@ -219,3 +252,183 @@ class HeartbeatWatchdog:
                     self._fail("rank 0 closed the heartbeat channel "
                                "without goodbye")
                 return
+
+
+class _WatchdogHandle:
+    """Parent-side handle for the spawned monitor; ``stop()`` on success,
+    ``abort()`` on a crash path that still wants the monitor gone."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self._proc = proc
+
+    def stop(self) -> None:
+        try:
+            # the explicit quit byte marks a CLEAN stop; a bare EOF (this
+            # process dying with the pipe open) reads as a crash
+            self._proc.stdin.write(b"q")
+            self._proc.stdin.flush()
+            self._proc.stdin.close()
+        except (OSError, ValueError):
+            pass
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait()          # reap: no zombie in long-lived hosts
+
+    def abort(self) -> None:
+        """Kill the monitor WITHOUT the goodbye protocol: its abrupt socket
+        close tells the peers this rank failed (crash semantics preserved),
+        and the host process is released from the armed kill_parent."""
+        try:
+            self._proc.kill()
+            self._proc.wait()
+        except OSError:
+            pass
+
+
+def spawn_watchdog(rank: int, world_size: int, master_addr: str, port: int,
+                   interval: float = 1.0, timeout: float = 30.0
+                   ) -> _WatchdogHandle:
+    """Launch the dead-peer monitor as a GIL-independent subprocess.
+
+    The child runs :class:`HeartbeatWatchdog` with a fail handler that
+    SIGTERMs (grace 5 s, then SIGKILLs) this process, so a vanished peer
+    turns into a prompt nonzero exit even while the trainer's main thread is
+    wedged inside a native collective holding the GIL. The child exits on
+    its own when this process dies or closes the handle's stdin pipe.
+    """
+    env = dict(os.environ)
+    # keep the child OUT of the TPU/jax world: the container's sitecustomize
+    # registers a PJRT plugin in every python process when these are set
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PYTHONPATH", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "simple_distributed_machine_learning_tpu.utils.failure",
+         "--rank", str(rank), "--world-size", str(world_size),
+         "--addr", master_addr, "--port", str(port),
+         "--interval", str(interval), "--timeout", str(timeout),
+         "--parent-pid", str(os.getpid())],
+        stdin=subprocess.PIPE, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+    return _WatchdogHandle(proc)
+
+
+def _monitor_main(argv=None) -> None:
+    """Child-process entry: run the watchdog protocol, kill the parent on
+    peer loss, exit quietly when the parent stops or disappears."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world-size", type=int, required=True)
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--parent-pid", type=int, required=True)
+    args = ap.parse_args(argv)
+
+    # pidfd (Linux): an unforgeable handle to THIS parent — immune to pid
+    # recycling between the SIGTERM grace and the SIGKILL
+    try:
+        parent_fd = os.pidfd_open(args.parent_pid)
+    except (AttributeError, OSError):
+        parent_fd = None
+
+    def _signal_parent(sig) -> bool:
+        try:
+            if parent_fd is not None:
+                signal.pidfd_send_signal(parent_fd, sig)
+            else:
+                os.kill(args.parent_pid, sig)
+            return True
+        except (ProcessLookupError, OSError):
+            return False
+
+    def kill_parent(what: str) -> None:
+        sys.stderr.write(_abort_message(args.rank, what))
+        sys.stderr.flush()
+        if _signal_parent(signal.SIGTERM):
+            # grace: poll for exit rather than one blind sleep, so SIGKILL
+            # is only sent while the (pidfd-pinned) parent still runs
+            for _ in range(50):
+                time.sleep(0.1)
+                if not _parent_alive():
+                    break
+            else:
+                _signal_parent(signal.SIGKILL)
+        os._exit(EXIT_PEER_LOST)
+
+    def _parent_alive() -> bool:
+        try:
+            if parent_fd is not None:
+                # a pidfd polls readable once the process exits
+                import select as _select
+                r, _, _ = _select.select([parent_fd], [], [], 0)
+                return not r
+            os.kill(args.parent_pid, 0)
+            return True
+        except (ProcessLookupError, OSError):
+            return False
+
+    def _parent_state() -> str:
+        """One-char /proc state of the trainer ('T' stopped, 'Z' zombie,
+        '?' unknown/non-Linux)."""
+        try:
+            with open(f"/proc/{args.parent_pid}/stat", "rb") as f:
+                # field 3, after the parenthesised comm (which may contain
+                # spaces): split on the LAST ')'
+                return f.read().rsplit(b")", 1)[1].split()[0].decode()
+        except (OSError, IndexError):
+            return "?"
+
+    wd = HeartbeatWatchdog(args.rank, args.world_size, args.addr, args.port,
+                           interval=args.interval, timeout=args.timeout,
+                           fail_handler=kill_parent)
+    wd.start()
+    # clean-shutdown signal: parent writes 'q' then closes our stdin; a bare
+    # EOF or a vanished parent pid means the parent CRASHED — close without
+    # goodbye so the peers abort instead of treating it as a clean exit.
+    # A trainer stuck in 'T' (SIGSTOPped) or 'Z' for > timeout counts as
+    # frozen: this monitor stays healthy and keeps heartbeating on the
+    # trainer's behalf, so ONLY this check preserves the frozen-peer
+    # abort the in-process design had (a GIL-wedged-but-running trainer is
+    # indistinguishable from a long native block and is left to the jax
+    # coordination service's own heartbeat).
+    import select
+    clean = False
+    stopped_since = None
+    while True:
+        r, _, _ = select.select([sys.stdin], [], [], args.interval)
+        if r:
+            data = os.read(sys.stdin.fileno(), 64)
+            if b"q" in data:
+                clean = True
+            if not data or b"q" in data:
+                break
+        if not _parent_alive():
+            break                       # parent already gone (crash path)
+        state = _parent_state()
+        if state in ("T", "Z"):
+            now = time.monotonic()
+            stopped_since = stopped_since or now
+            if now - stopped_since > args.timeout:
+                sys.stderr.write(_abort_message(
+                    args.rank, f"trainer pid {args.parent_pid} has been in "
+                               f"state '{state}' for >{args.timeout:.0f}s"))
+                sys.stderr.flush()
+                _signal_parent(signal.SIGKILL)
+                wd.stop(goodbye=False)  # peers must see this as a failure
+                os._exit(EXIT_PEER_LOST)
+        else:
+            stopped_since = None
+    wd.stop(goodbye=clean)
+
+
+if __name__ == "__main__":
+    _monitor_main()

@@ -1,0 +1,91 @@
+"""In-process watchdog unit tests (utils/failure.py).
+
+The OS-process integration path (actually SIGKILLing a rank) lives in
+tests/test_multiprocess.py::test_dead_peer_aborts_rank0; these cover the
+protocol edges cheaply: goodbye-vs-crash disambiguation in both directions
+and staleness detection, with an injected fail handler instead of os._exit.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from simple_distributed_machine_learning_tpu.utils.failure import (
+    HeartbeatWatchdog,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _pair(port, **kw):
+    fails0, fails1 = [], []
+    w0 = HeartbeatWatchdog(0, 2, "localhost", port, fail_handler=fails0.append,
+                           **kw).start()
+    w1 = HeartbeatWatchdog(1, 2, "localhost", port, fail_handler=fails1.append,
+                           **kw).start()
+    return w0, w1, fails0, fails1
+
+
+def test_clean_shutdown_no_spurious_failure():
+    """Either side stopping cleanly (goodbye byte) must not trip the other —
+    including rank 0 exiting FIRST while rank 1 keeps heartbeating."""
+    w0, w1, fails0, fails1 = _pair(_free_port(), interval=0.1, timeout=5.0)
+    assert _wait(lambda: w1._client is not None)
+    w0.stop()                      # master leaves first
+    time.sleep(0.5)                # several heartbeat intervals
+    w1.stop()
+    assert fails0 == [] and fails1 == []
+
+
+def test_peer_socket_death_detected():
+    """A peer whose socket dies without goodbye is reported on rank 0."""
+    w0, w1, fails0, _ = _pair(_free_port(), interval=0.1, timeout=5.0)
+    assert _wait(lambda: w1._client is not None)
+    w1._client.close()             # simulate a killed process (no goodbye)
+    assert _wait(lambda: len(fails0) > 0)
+    assert "vanished" in fails0[0]
+    w0.stop()
+
+
+def test_master_death_detected():
+    """Rank 0's socket dying without goodbye is reported on the peer."""
+    w0, w1, fails0, fails1 = _pair(_free_port(), interval=0.1, timeout=5.0)
+    assert _wait(lambda: len(w0._conns) == 1)
+    for c in w0._conns:            # kill the server side without goodbye
+        c.close()
+    try:
+        w0._server.close()
+    except OSError:
+        pass
+    assert _wait(lambda: len(fails1) > 0)
+    assert "rank 0" in fails1[0]
+    w1.stop()
+
+
+def test_stale_peer_detected():
+    """A connected-but-frozen peer (open socket, no heartbeats) trips the
+    staleness monitor within ~timeout."""
+    port = _free_port()
+    fails0 = []
+    w0 = HeartbeatWatchdog(0, 2, "localhost", port, interval=0.1, timeout=0.8,
+                           fail_handler=fails0.append).start()
+    # a raw socket that connects and then goes silent — no watchdog client
+    frozen = socket.create_connection(("localhost", port))
+    assert _wait(lambda: len(fails0) > 0, timeout=10.0)
+    assert "heartbeat" in fails0[0] or "stopped" in fails0[0]
+    frozen.close()
+    w0.stop()

@@ -85,6 +85,29 @@ def test_pipeline_matches_fused_loss_and_grad(n_stages, n_data, n_micro):
                                np.asarray(want_buf), rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.parametrize("n_micro", [1, 4])
+def test_loss_only_engine_matches_full(n_micro):
+    """Pipeline.loss (the training path: no logits accumulator in the scan
+    carry) must produce the identical value AND gradient as
+    loss_and_logits()[0] — same RNG stream, same reductions."""
+    key = jax.random.key(7)
+    stages, wire_dim, out_dim, x, targets = _make_problem(
+        key, [12, 16, 10], 2, 8 * n_micro)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=n_micro)
+    buf = pipe.init_params()
+    k = jax.random.key(1)
+
+    l_full, g_full = jax.value_and_grad(
+        lambda b: pipe.loss_and_logits(b, x, targets, k, False)[0])(buf)
+    l_only, g_only = jax.value_and_grad(
+        lambda b: pipe.loss(b, x, targets, k, False))(buf)
+    np.testing.assert_allclose(float(l_only), float(l_full),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_only), np.asarray(g_full),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_training_trajectory_matches_fused():
     """5 SGD(momentum) steps on the 2-stage pipeline == fused single-device."""
     key = jax.random.key(7)
