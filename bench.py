@@ -173,6 +173,51 @@ def _configs():
     }
 
 
+def _smoke_check(timeout_s: float = 90.0) -> None:
+    """Fail fast with a diagnosis if the accelerator is unresponsive.
+
+    A wedged remote-chip tunnel (e.g. a prior client killed mid-execution
+    leaving its claim held server-side) blocks the first dispatch forever;
+    without this check the whole bench silently hangs until the outer
+    harness timeout with no clue in the output.
+    """
+    import threading
+
+    import jax.numpy as jnp
+
+    done = threading.Event()
+    err: list[BaseException] = []
+
+    def probe():
+        try:
+            jnp.ones((128, 128)).block_until_ready()
+        except BaseException as e:  # noqa: BLE001 - re-raised in main thread
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if done.wait(timeout_s):
+        if err:
+            # the probe RAISED (plugin/init error) — that is not a hang;
+            # surface the real exception instead of the wedged diagnosis
+            raise err[0]
+        return
+    # NO jax calls here: with the device wedged even jax.default_backend()
+    # blocks on the backend-init lock the probe thread is stuck holding
+    sys.stderr.write(
+        f"bench: accelerator unresponsive - a 128x128 constant did not "
+        f"materialize within {timeout_s:.0f}s; the device/tunnel is "
+        f"wedged (stale claim from a killed client?); no measurement "
+        f"possible\n")
+    sys.stderr.flush()
+    # os._exit, not raise: with the device wedged, normal interpreter exit
+    # hangs too (jax's atexit backend finalization blocks on the same dead
+    # tunnel)
+    os._exit(17)
+
+
 def measure(name: str, spec: dict, windows: int = 5) -> dict:
     import jax
     import jax.numpy as jnp
@@ -333,6 +378,7 @@ def main() -> None:
 
     configs = _configs()
     names = list(configs) if args.all else [args.config]
+    _smoke_check()
     rows = []
     for name in names:
         spec = (dict(configs[name], steps_override=args.steps)

@@ -68,6 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--batch-size', type=int, default=60)
     g.add_argument('--lr', type=float, default=0.1)
     g.add_argument('--momentum', type=float, default=0.5)
+    g.add_argument('--optimizer', choices=("sgd", "adamw"), default="sgd",
+                   help="sgd = the reference's SGD(momentum); adamw = "
+                        "torch-semantics decoupled weight decay")
+    g.add_argument('--weight-decay', type=float, default=0.01,
+                   help="weight decay for --optimizer adamw")
+    g.add_argument('--zero1', action='store_true',
+                   help="ZeRO-1: shard optimizer state over the data axis "
+                        "(cuts its memory by dp; GSPMD inserts the "
+                        "collectives)")
     g.add_argument('--data-root', type=str, default="data",
                    help="directory with MNIST IDX files (synthetic fallback "
                         "if absent)")
@@ -240,8 +249,8 @@ def _dispatch(args) -> None:
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
-                         resume=not args.no_resume)
-    _fit(args, Trainer(pipe, train_ds, test_ds, config))
+                         resume=not args.no_resume, zero1=args.zero1)
+    _fit(args, Trainer(pipe, train_ds, test_ds, config, opt=_make_opt(args)))
 
 
 def _compute_dtype(args):
@@ -249,6 +258,16 @@ def _compute_dtype(args):
         return None
     import jax.numpy as jnp
     return jnp.bfloat16
+
+
+def _make_opt(args):
+    from simple_distributed_machine_learning_tpu.train.optimizer import (
+        adamw,
+        sgd,
+    )
+    if args.optimizer == "adamw":
+        return adamw(args.lr, weight_decay=args.weight_decay)
+    return sgd(args.lr, args.momentum)
 
 
 def _fit(args, trainer) -> None:
@@ -297,8 +316,8 @@ def _run_gpt(args, n_stages: int, key) -> None:
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
-                         resume=not args.no_resume)
-    _fit(args, Trainer(pipe, train_ds, test_ds, config))
+                         resume=not args.no_resume, zero1=args.zero1)
+    _fit(args, Trainer(pipe, train_ds, test_ds, config, opt=_make_opt(args)))
 
 
 if __name__ == "__main__":

@@ -1,7 +1,10 @@
 """Training: optimizers, the compiled train step, driver loops, checkpointing."""
 
 from simple_distributed_machine_learning_tpu.train.optimizer import (  # noqa: F401
+    adamw,
+    from_optax,
     sgd,
+    shard_opt_state_zero1,
 )
 from simple_distributed_machine_learning_tpu.train.step import (  # noqa: F401
     make_eval_step,

@@ -47,6 +47,76 @@ def sgd(learning_rate: float, momentum: float = 0.0) -> Optimizer:
     return Optimizer(init, update)
 
 
+def adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+          eps: float = 1e-8, weight_decay: float = 0.01) -> Optimizer:
+    """torch-semantics AdamW (decoupled weight decay, bias-corrected
+    moments — torch.optim.AdamW's update rule). State = (step, m, v),
+    m/v like-sharded with the params."""
+    import jax.numpy as jnp
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return (jnp.zeros((), jnp.int32), zeros(), zeros())
+
+    def update(grads, state, params):
+        step, m, v = state
+        step = step + 1
+        t = step.astype(jnp.float32)
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v, grads)
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+
+        def upd(p, m_, v_):
+            # decoupled decay first (torch applies p *= 1 - lr*wd before the
+            # Adam step), then the bias-corrected moment update
+            p = p * (1 - learning_rate * weight_decay)
+            return p - learning_rate * (m_ / bc1) / (
+                jnp.sqrt(v_ / bc2) + eps)
+
+        return jax.tree.map(upd, params, m, v), (step, m, v)
+
+    return Optimizer(init, update)
+
+
+def shard_opt_state_zero1(state: Any, mesh, param_spec) -> Any:
+    """ZeRO-1: shard the optimizer state's packed param axis over the DATA
+    mesh axis (on top of the stage/model/expert sharding the buffer already
+    has).
+
+    Optimizer state is pure per-element memory — unlike params it is never
+    read by the forward pass — so replicating it across data-parallel
+    replicas (what like-sharded init does) wastes n_data x its bytes. With
+    the state's last axis additionally sharded over ``data``, GSPMD
+    partitions the elementwise update across data shards and inserts the
+    all-gather for the params the next step needs — the ZeRO-1 recipe
+    expressed purely as a sharding annotation, no hand-written collectives
+    (the TPU-idiomatic equivalent of what DeepSpeed does with explicit
+    reduce-scatter/all-gather).
+
+    Buffer-shaped leaves get ``P(*param_spec[:-1], 'data')``; scalar leaves
+    (step counters) stay replicated.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_data = mesh.shape.get("data", 1)
+    spec = P(*tuple(param_spec)[:-1], "data")
+
+    def place(leaf):
+        if getattr(leaf, "ndim", 0) == 0:
+            return leaf
+        if leaf.shape[-1] % n_data:
+            import sys
+            sys.stderr.write(
+                f"zero1: packed param axis {leaf.shape[-1]} not divisible "
+                f"by data axis {n_data} — this state leaf stays REPLICATED "
+                f"(no memory saving)\n")
+            return leaf
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, state)
+
+
 def from_optax(tx) -> Optimizer:
     """Adapt an optax GradientTransformation to this interface."""
     import optax

@@ -25,7 +25,11 @@ from simple_distributed_machine_learning_tpu.data.mnist import (
     prefetch_batches,
 )
 from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
-from simple_distributed_machine_learning_tpu.train.optimizer import Optimizer, sgd
+from simple_distributed_machine_learning_tpu.train.optimizer import (
+    Optimizer,
+    sgd,
+    shard_opt_state_zero1,
+)
 from simple_distributed_machine_learning_tpu.train.step import (
     make_eval_step,
     make_train_step,
@@ -53,6 +57,9 @@ class TrainConfig:
     # written after every epoch and auto-resumed from on construction
     checkpoint_dir: str | None = None
     resume: bool = True
+    # ZeRO-1: shard optimizer state over the data axis (pure sharding
+    # annotation; GSPMD inserts the collectives — optimizer.py)
+    zero1: bool = False
 
 
 class Trainer:
@@ -68,6 +75,9 @@ class Trainer:
         self.opt = opt or sgd(self.config.learning_rate, self.config.momentum)
         self.buf = pipe.init_params()
         self.opt_state = self.opt.init(self.buf)
+        if self.config.zero1:
+            self.opt_state = shard_opt_state_zero1(
+                self.opt_state, pipe.mesh, pipe.param_spec())
         self._train_step = make_train_step(pipe, self.opt)
         self._eval_step = make_eval_step(pipe)
         self._key = jax.random.key(self.config.seed)

@@ -260,8 +260,32 @@ class _WatchdogHandle:
 
     def __init__(self, proc: subprocess.Popen):
         self._proc = proc
+        self._closing = False
+        # visibility thread: a monitor that dies on its own (OOM-kill,
+        # operator mistake) leaves this rank unprotected AND its abrupt
+        # socket close makes the PEERS read this rank as crashed — log it
+        # loudly so the resulting run teardown is attributable. (Best
+        # effort: this thread needs the GIL; the monitor exists precisely
+        # because the trainer may hold it. The log is diagnosis, not the
+        # protection mechanism.)
+        t = threading.Thread(target=self._watch_monitor, daemon=True)
+        t.start()
+
+    def _watch_monitor(self) -> None:
+        while not self._closing:
+            if self._proc.poll() is not None:
+                if not self._closing:
+                    sys.stderr.write(
+                        f"[watchdog] monitor subprocess exited unexpectedly "
+                        f"(rc={self._proc.returncode}): dead-peer protection "
+                        f"is OFF for this rank, and peers may read this "
+                        f"rank's heartbeat loss as a crash\n")
+                    sys.stderr.flush()
+                return
+            time.sleep(2.0)
 
     def stop(self) -> None:
+        self._closing = True
         try:
             # the explicit quit byte marks a CLEAN stop; a bare EOF (this
             # process dying with the pipe open) reads as a crash
@@ -280,6 +304,7 @@ class _WatchdogHandle:
         """Kill the monitor WITHOUT the goodbye protocol: its abrupt socket
         close tells the peers this rank failed (crash semantics preserved),
         and the host process is released from the armed kill_parent."""
+        self._closing = True
         try:
             self._proc.kill()
             self._proc.wait()

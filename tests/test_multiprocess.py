@@ -103,6 +103,24 @@ def test_two_process_launch_reference_workload_lenet(tmp_path):
     assert "Train Epoch" not in r1.stdout
 
 
+def test_two_process_launch_gpt(tmp_path):
+    """The GPT family end to end across a real process boundary: embedding
+    stage on rank 0, head stage on rank 1, per-token LM loss, GPipe
+    microbatching — same verbatim launch line."""
+    r0, r1 = run_two_ranks([
+        "--model", "gpt", "--epochs", "1", "--microbatches", "2",
+        "--batch-size", "32",
+        "--data-root", str(tmp_path / "nodata"),
+    ], timeout=560)
+    assert r0.returncode == 0, f"rank0 failed:\n{r0.stderr[-3000:]}"
+    assert r1.returncode == 0, f"rank1 failed:\n{r1.stderr[-3000:]}"
+    assert "Train Epoch: 1" in r0.stdout
+    assert "Test set: Average loss:" in r0.stdout
+    assert "Train Epoch" not in r1.stdout
+    last = [ln for ln in r0.stdout.splitlines() if "Loss:" in ln][-1]
+    assert "nan" not in last.lower()
+
+
 def test_dead_peer_aborts_rank0(tmp_path):
     """SURVEY §5.3: kill rank 1 mid-run; rank 0 must exit nonzero promptly
     instead of hanging forever inside a collective (the reference hangs:
