@@ -99,8 +99,12 @@ def shard_opt_state_zero1(state: Any, mesh, param_spec) -> Any:
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n_data = mesh.shape.get("data", 1)
-    spec = P(*tuple(param_spec)[:-1], "data")
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        DATA_AXIS,
+    )
+
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    spec = P(*tuple(param_spec)[:-1], DATA_AXIS)
 
     def place(leaf):
         if getattr(leaf, "ndim", 0) == 0:

@@ -33,3 +33,26 @@ def test_cli_end_to_end_single_process(capsys):
 def test_cli_sp_requires_gpt():
     with pytest.raises(SystemExit, match="--sp is only supported"):
         main(["--rank", "0", "--model", "mlp", "--sp", "2"])
+
+
+def test_cli_profile_writes_trace(tmp_path):
+    """--profile captures an XProf trace of the whole run (SURVEY §5.1)."""
+    import os
+
+    trace_dir = str(tmp_path / "trace")
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--mlp-dims", "784,32,10", "--stages", "2", "--epochs", "1",
+          "--data-root", "/nonexistent", "--profile", trace_dir])
+    found = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir)
+             for f in fs]
+    assert found, "profiler produced no trace files"
+
+
+def test_cli_adamw_zero1(capsys):
+    """--optimizer adamw --zero1 end to end through the CLI."""
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--mlp-dims", "784,32,10", "--stages", "2", "--epochs", "1",
+          "--lr", "0.001", "--optimizer", "adamw", "--zero1",
+          "--data-root", "/nonexistent", "--microbatches", "2"])
+    out = capsys.readouterr().out
+    assert "Test set: Average loss:" in out
