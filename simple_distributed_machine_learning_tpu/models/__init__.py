@@ -7,6 +7,8 @@ microbatching.
 
 from simple_distributed_machine_learning_tpu.models.gpt import (  # noqa: F401
     GPTConfig,
+    generate,
+    make_decoder,
     make_gpt_stages,
 )
 from simple_distributed_machine_learning_tpu.models.lenet import (  # noqa: F401

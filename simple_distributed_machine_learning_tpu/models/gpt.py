@@ -327,3 +327,91 @@ def _grad_sync_non_expert(params: dict) -> dict:
         lambda path, leaf: (leaf if _is_expert_leaf(path)
                             else grad_sync(leaf, EXPERT_AXIS)),
         params)
+
+
+def generate(stages, prompt: jax.Array, n_new: int,
+             key: jax.Array | None = None,
+             temperature: float = 0.0) -> jax.Array:
+    """Autoregressive decoding from the (single-device) stage composition.
+
+    ``prompt``: [B, T0] int tokens; returns [B, T0 + n_new]. The whole decode
+    is ONE ``lax.scan`` over a fixed-length token buffer — static shapes, no
+    per-step Python dispatch (the TPU-idiomatic decode shape). Each step
+    recomputes the full prefix forward; causal masking makes the
+    not-yet-written zero padding at positions > current length invisible to
+    the prediction read at the current position. Full-prefix recompute is
+    O(T²) per sequence — right for reference-scale models; a KV-cache decode
+    path is the standard next optimization.
+
+    ``temperature=0`` → greedy argmax; ``> 0`` → softmax sampling with
+    ``key`` (required). One-shot convenience: retraces per call — build the
+    decoder once with :func:`make_decoder` for repeated generation.
+
+    The reference has no inference path at all (eval only,
+    ``/root/reference/simple_distributed.py:119-132``); this is a capability
+    extension.
+    """
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    key = key if key is not None else jax.random.key(0)
+    dec = make_decoder(stages, int(prompt.shape[1]), n_new,
+                       temperature=temperature)
+    return dec([s.params for s in stages], prompt, key)
+
+
+def make_decoder(stages, prompt_len: int, n_new: int,
+                 temperature: float = 0.0):
+    """Build the jitted decode fn: ``decode(params, prompt, key) ->
+    [B, prompt_len + n_new]`` tokens.
+
+    Like the ``make_train_step`` pattern: build ONCE and reuse across calls
+    to amortize the trace/compile (``generate`` is the one-shot convenience
+    wrapper and rebuilds per call). Single-device composition only: stages
+    from a ``cfg.n_seq > 1`` build use mesh collectives in their applies and
+    cannot run here — decode with an ``n_seq=1`` build of the same weights.
+    """
+    from jax import lax
+
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        fused_reference,
+    )
+
+    if prompt_len < 1:
+        raise ValueError(
+            "generate needs a non-empty prompt (t0 >= 1): the first decoded "
+            "token is conditioned on the prompt's last position")
+    # the stages are traced at a fixed sequence length (stage 0's in_shape);
+    # decode inside that static buffer
+    seq_len = int(stages[0].in_shape[0])
+    if prompt_len + n_new > seq_len:
+        raise ValueError(
+            f"prompt {prompt_len} + n_new {n_new} exceeds the model's "
+            f"sequence length {seq_len}")
+    fused = fused_reference(stages)
+
+    @jax.jit
+    def decode(params, prompt, key):
+        b = prompt.shape[0]
+        buf = jnp.zeros((b, seq_len), jnp.int32)
+        buf = lax.dynamic_update_slice_in_dim(
+            buf, prompt.astype(jnp.int32), 0, 1)
+
+        def step(carry, i):
+            buf, k = carry
+            logp = fused(params, buf.astype(jnp.float32), k, True)
+            # prediction for position i comes from the read at i-1
+            row = lax.dynamic_index_in_dim(logp, i - 1, 1, keepdims=False)
+            if temperature > 0.0:
+                k, ks = jax.random.split(k)
+                tok = jax.random.categorical(ks, row / temperature, axis=-1)
+            else:
+                tok = jnp.argmax(row, axis=-1)
+            buf = lax.dynamic_update_slice_in_dim(
+                buf, tok[:, None].astype(jnp.int32), i, 1)
+            return (buf, k), None
+
+        (buf, _), _ = lax.scan(step, (buf, key),
+                               prompt_len + jnp.arange(n_new))
+        return buf[:, :prompt_len + n_new]
+
+    return decode

@@ -132,3 +132,57 @@ def test_moe_gpt_pipeline_trains():
         buf, opt_state, l = step(buf, opt_state, x, y, jax.random.key(i))
         l0 = float(l) if l0 is None else l0
     assert float(l) < l0
+
+
+def test_generate_greedy_matches_stepwise_argmax():
+    """One-scan greedy decode == manually rolling argmax one token at a
+    time (pins causal masking of the not-yet-written buffer tail and the
+    read-at-i-1 indexing)."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        generate,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        fused_reference,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab)
+
+    out = generate(stages, prompt, n_new=5)
+    assert out.shape == (2, 11)
+    np.testing.assert_array_equal(np.asarray(out[:, :6]), np.asarray(prompt))
+
+    # manual roll: full-length zero-padded buffer, one argmax at a time
+    fused = fused_reference(stages)
+    params = [s.params for s in stages]
+    buf = np.zeros((2, cfg.seq_len), np.int32)
+    buf[:, :6] = np.asarray(prompt)
+    for i in range(6, 11):
+        logp = fused(params, jnp.asarray(buf, jnp.float32),
+                     jax.random.key(0), True)
+        buf[:, i] = np.asarray(jnp.argmax(logp[:, i - 1], axis=-1))
+    np.testing.assert_array_equal(np.asarray(out), buf[:, :11])
+
+
+def test_generate_sampling_shapes_and_validation():
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        generate,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, cfg.vocab)
+    out = generate(stages, prompt, n_new=4, key=jax.random.key(2),
+                   temperature=1.0)
+    assert out.shape == (2, 8)
+    assert int(out.max()) < cfg.vocab and int(out.min()) >= 0
+
+    with pytest.raises(ValueError, match="exceeds the model's sequence"):
+        generate(stages, prompt, n_new=13)
+    with pytest.raises(ValueError, match="needs a PRNG key"):
+        generate(stages, prompt, n_new=2, temperature=0.5)
