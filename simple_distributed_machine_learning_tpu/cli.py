@@ -56,6 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--microbatches', type=int, default=1,
                    help="GPipe microbatches per step (1 = reference's "
                         "sequential schedule)")
+    g.add_argument('--schedule', choices=("gpipe", "1f1b"), default="gpipe",
+                   help="pipeline schedule: gpipe = scanned fwd sweep + "
+                        "autodiff backward (activation memory grows with "
+                        "microbatches); 1f1b = interleaved one-forward-one-"
+                        "backward with recompute (memory bounded by the "
+                        "stage count; stage+data meshes only)")
     g.add_argument('--dp', type=int, default=1,
                    help="data-parallel mesh width (batch must divide by "
                         "dp * microbatches)")
@@ -73,6 +79,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "torch-semantics decoupled weight decay")
     g.add_argument('--weight-decay', type=float, default=0.01,
                    help="weight decay for --optimizer adamw")
+    g.add_argument('--lr-schedule',
+                   choices=("constant", "cosine", "warmup-cosine", "step"),
+                   default="constant",
+                   help="learning-rate schedule over the whole run "
+                        "(epochs * batches steps); evaluated inside the "
+                        "compiled step")
+    g.add_argument('--warmup-steps', type=int, default=0,
+                   help="linear-warmup steps for --lr-schedule warmup-cosine")
+    g.add_argument('--lr-step-size', type=int, default=100,
+                   help="steps between decays for --lr-schedule step")
+    g.add_argument('--lr-gamma', type=float, default=0.1,
+                   help="decay factor for --lr-schedule step")
+    g.add_argument('--clip-norm', type=float, default=0.0,
+                   help="clip gradients to this global L2 norm before the "
+                        "update (torch clip_grad_norm_ semantics; 0 "
+                        "disables); replication-corrected on tp/ep meshes")
     g.add_argument('--zero1', action='store_true',
                    help="ZeRO-1: shard optimizer state over the data axis "
                         "(cuts its memory by dp; GSPMD inserts the "
@@ -81,6 +103,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory with MNIST IDX files (synthetic fallback "
                         "if absent)")
     g.add_argument('--seed', type=int, default=0)
+    g.add_argument('--shuffle', action='store_true',
+                   help="seeded per-epoch shuffle of the train set (off by "
+                        "default: the reference trains in fixed order)")
     g.add_argument('--mlp-dims', type=str, default="784,512,10",
                    help="comma-separated layer widths for --model=mlp")
     g.add_argument('--checkpoint-dir', type=str, default=None,
@@ -90,6 +115,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--no-resume', action='store_true',
                    help="with --checkpoint-dir: start fresh, ignore an "
                         "existing checkpoint")
+    g.add_argument('--async-checkpoint', action='store_true',
+                   help="overlap the checkpoint file write with the next "
+                        "epoch (the sharded gather stays synchronous)")
     g.add_argument('--experts', type=int, default=0,
                    help="for --model=gpt: replace each block's MLP with a "
                         "top-2-routed mixture of this many experts (0 = dense)")
@@ -201,6 +229,10 @@ def _dispatch(args) -> None:
         raise SystemExit("--sp is only supported with --model=gpt")
     if args.ep > 1 and (args.model != "gpt" or args.experts < 1):
         raise SystemExit("--ep needs --model=gpt with --experts > 0")
+    if args.schedule == "1f1b" and (args.tp > 1 or args.sp > 1
+                                    or args.ep > 1):
+        raise SystemExit("--schedule 1f1b supports stage+data meshes only "
+                         "(no --tp/--sp/--ep)")
     if args.model == "gpt":
         _run_gpt(args, n_stages, key)
         return
@@ -245,12 +277,17 @@ def _dispatch(args) -> None:
     mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_model=args.tp)
     pipe = Pipeline(stages, mesh, wire_dim, out_dim,
                     n_microbatches=args.microbatches,
-                    compute_dtype=_compute_dtype(args), remat=args.remat)
+                    compute_dtype=_compute_dtype(args), remat=args.remat,
+                    schedule=args.schedule)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
-                         resume=not args.no_resume, zero1=args.zero1)
-    _fit(args, Trainer(pipe, train_ds, test_ds, config, opt=_make_opt(args)))
+                         resume=not args.no_resume, zero1=args.zero1,
+                         async_checkpoint=args.async_checkpoint,
+                         shuffle=args.shuffle)
+    _fit(args, Trainer(pipe, train_ds, test_ds, config,
+                       opt=_make_opt(args, _total_steps(args, train_ds),
+                                     pipe)))
 
 
 def _compute_dtype(args):
@@ -260,14 +297,35 @@ def _compute_dtype(args):
     return jnp.bfloat16
 
 
-def _make_opt(args):
+def _make_opt(args, total_steps: int, pipe=None):
     from simple_distributed_machine_learning_tpu.train.optimizer import (
         adamw,
+        clip_by_global_norm,
         sgd,
     )
+    from simple_distributed_machine_learning_tpu.train import schedules
+
+    if args.lr_schedule == "cosine":
+        lr = schedules.cosine(args.lr, total_steps)
+    elif args.lr_schedule == "warmup-cosine":
+        lr = schedules.warmup_cosine(args.lr, args.warmup_steps, total_steps)
+    elif args.lr_schedule == "step":
+        lr = schedules.step_decay(args.lr, args.lr_step_size, args.lr_gamma)
+    else:
+        lr = args.lr
     if args.optimizer == "adamw":
-        return adamw(args.lr, weight_decay=args.weight_decay)
-    return sgd(args.lr, args.momentum)
+        opt = adamw(lr, weight_decay=args.weight_decay)
+    else:
+        opt = sgd(lr, args.momentum)
+    if args.clip_norm > 0:
+        weights = pipe.replication_weights() if pipe is not None else None
+        opt = clip_by_global_norm(opt, args.clip_norm, weights)
+    return opt
+
+
+def _total_steps(args, train_ds) -> int:
+    per_epoch = max(1, -(-len(train_ds.x) // args.batch_size))
+    return args.epochs * per_epoch
 
 
 def _fit(args, trainer) -> None:
@@ -312,12 +370,17 @@ def _run_gpt(args, n_stages: int, key) -> None:
                      n_expert=args.ep)
     pipe = Pipeline(stages, mesh, wire_dim, out_shape,
                     n_microbatches=args.microbatches,
-                    compute_dtype=_compute_dtype(args), remat=args.remat)
+                    compute_dtype=_compute_dtype(args), remat=args.remat,
+                    schedule=args.schedule)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
-                         resume=not args.no_resume, zero1=args.zero1)
-    _fit(args, Trainer(pipe, train_ds, test_ds, config, opt=_make_opt(args)))
+                         resume=not args.no_resume, zero1=args.zero1,
+                         async_checkpoint=args.async_checkpoint,
+                         shuffle=args.shuffle)
+    _fit(args, Trainer(pipe, train_ds, test_ds, config,
+                       opt=_make_opt(args, _total_steps(args, train_ds),
+                                     pipe)))
 
 
 if __name__ == "__main__":

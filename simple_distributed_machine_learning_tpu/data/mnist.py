@@ -151,18 +151,26 @@ class Batch(NamedTuple):
     n_valid: int  # <= len(x): trailing rows are padding
 
 
-def batches(ds: Dataset, batch_size: int, pad_last: bool = True
-            ) -> Iterator[Batch]:
-    """Fixed-order batches (reference uses no shuffle, ``:94-95``).
+def batches(ds: Dataset, batch_size: int, pad_last: bool = True,
+            shuffle_seed: int | None = None) -> Iterator[Batch]:
+    """Batches in fixed order (the reference's default — no shuffle,
+    ``:94-95``) or a seeded permutation (``shuffle_seed``: deterministic and
+    reproducible per epoch, unlike the reference's implicit global RNG).
 
     The pipeline is a compiled static-shape program, so a ragged final batch
     (the reference's test set: 1000 = 16·60 + 40) is zero-padded to full size
     and carries ``n_valid`` for masked loss/accuracy accumulation.
     """
     n = len(ds.x)
+    # mask into RandomState's 32-bit range: callers derive epoch seeds by
+    # multiplication (trainer: seed * 100003 + epoch) which overflows it
+    order = (np.random.RandomState(shuffle_seed % 2**32).permutation(n)
+             if shuffle_seed is not None else None)
     for start in range(0, n, batch_size):
-        x = ds.x[start:start + batch_size]
-        y = ds.y[start:start + batch_size]
+        idx = (order[start:start + batch_size] if order is not None
+               else slice(start, start + batch_size))
+        x = ds.x[idx]
+        y = ds.y[idx]
         n_valid = len(x)
         if n_valid < batch_size:
             if not pad_last:
@@ -173,15 +181,24 @@ def batches(ds: Dataset, batch_size: int, pad_last: bool = True
         yield Batch(x, y, n_valid)
 
 
-def prefetch_batches(ds: Dataset, batch_size: int) -> Iterator[Batch]:
+def prefetch_batches(ds: Dataset, batch_size: int,
+                     shuffle_seed: int | None = None) -> Iterator[Batch]:
     """Like :func:`batches` (pad_last semantics) but batch assembly runs on
     the native C++ prefetcher thread (``native/data_loader.cpp``) when the
     toolchain is available, overlapping gather/pad with the device step —
     the TPU-side analogue of the torch DataLoader worker the reference leans
     on (SURVEY §2.3). Falls back to the pure-Python iterator transparently.
+
+    ``shuffle_seed``: seeded epoch shuffle; the permutation is applied to the
+    (host-resident) arrays up front so the native prefetcher still streams
+    contiguous slices.
     """
     from simple_distributed_machine_learning_tpu.data import native_loader
 
+    if shuffle_seed is not None:
+        order = np.random.RandomState(
+            shuffle_seed % 2**32).permutation(len(ds.x))
+        ds = Dataset(ds.x[order], ds.y[order])
     if not native_loader.available():
         yield from batches(ds, batch_size, pad_last=True)
         return

@@ -71,7 +71,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
     output block is written on the last one (standard revisiting pattern).
 
     q_ref: [1, block_q, d]; k_ref/v_ref: [1, block_k, d];
-    o_ref: [1, block_q, d]; l_ref/m_ref: [1, block_q] (saved for backward);
+    o_ref: [1, block_q, d]; l_ref/m_ref: [1, 1, block_q] (saved for
+    backward — the length-1 middle axis keeps the last-two block dims
+    (1, block_q) legal under Mosaic's (8, 128) tiling rule: a 2-D
+    [bh, tq] layout with (1, block_q) blocks fails to lower on real TPU);
     l_scr/m_scr: [block_q, 128] f32 (value broadcast across lanes).
     """
     qi = pl.program_id(1)
@@ -89,11 +92,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
     # causal: k-blocks wholly past the diagonal contribute nothing — skip
     @pl.when(k_start < q_start + block_q)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
+        # dots run in the INPUT dtype (bf16 stays bf16 on the MXU — 3x the
+        # f32 throughput) with f32 accumulation via preferred_element_type;
+        # only the softmax statistics are f32
+        q = q_ref[0]                                      # [bq, d]
+        k = k_ref[0]                                      # [bk, d]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         qpos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         kpos = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = (qpos >= kpos) & (kpos < t_real)
@@ -106,7 +112,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
         corr = jnp.exp(m_prev - m_new)
         acc_scr[...] = (acc_scr[...] * corr[:, None]
                         + jax.lax.dot_general(
-                            p, v, (((1,), (0,)), ((), ())),
+                            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32))
         l_new = l_prev * corr + p.sum(axis=1)
         l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
@@ -117,8 +123,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
         l = l_scr[:, 0]
         o_ref[0] = (acc_scr[...]
                     / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-        l_ref[0] = l
-        m_ref[0] = m_scr[:, 0]
+        l_ref[0, 0] = l
+        m_ref[0, 0] = m_scr[:, 0]
 
 
 def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
@@ -154,13 +160,13 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dp), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, dp), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, dp), jnp.float32),
@@ -174,6 +180,12 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
     l = l.reshape(b, h, tq)[:, :, :t]
     m = m.reshape(b, h, tq)[:, :, :t]
     return o, l, m
+
+
+def _rows_3d(x: jax.Array, bh: int, tq: int) -> jax.Array:
+    """[B, H, Tpad] -> [bh, 1, tq]: the Mosaic-legal per-row layout (see
+    ``_flash_kernel`` docstring on the length-1 middle axis)."""
+    return x.reshape(bh, 1, tq)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -197,16 +209,18 @@ def _recompute_p(q_ref, k_ref, m_ref, li_ref, q_start, k_start,
                  block_q, block_k, t_real, scale):
     """Shared backward-block math: re-derive the probability block
     ``p = exp(s - m) / l`` from the saved softmax statistics (exactly the
-    forward's value — no [T, T] residuals; flash-attention-2 practice)."""
-    qs = q_ref[0].astype(jnp.float32) * scale             # [bq, d]
-    kk = k_ref[0].astype(jnp.float32)                     # [bk, d]
+    forward's value — no [T, T] residuals; flash-attention-2 practice).
+    Returns q/k in their INPUT dtype (the callers' dots stay on the native-
+    dtype MXU path) and p in f32."""
+    qs = q_ref[0]                                         # [bq, d]
+    kk = k_ref[0]                                         # [bk, d]
     s = jax.lax.dot_general(qs, kk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * scale
     qpos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     kpos = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     mask = (qpos >= kpos) & (kpos < t_real) & (qpos < t_real)
-    m_row = m_ref[0]                                      # [bq]
-    li_row = li_ref[0]
+    m_row = m_ref[0, 0]                                   # [bq]
+    li_row = li_ref[0, 0]
     p = jnp.where(mask, jnp.exp(s - m_row[:, None]) * li_row[:, None], 0.0)
     return qs, kk, p
 
@@ -235,13 +249,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, li_ref, dl_ref,
     def _compute():
         _, kk, p = _recompute_p(q_ref, k_ref, m_ref, li_ref, q_start,
                                 k_start, block_q, block_k, t_real, scale)
-        do = do_ref[0].astype(jnp.float32)                # [bq, d]
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        do = do_ref[0]                                    # [bq, d]
+        v = v_ref[0]                                      # [bk, d]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dl_ref[0][:, None])
-        dq_scr[...] += scale * jax.lax.dot_general(
-            ds, kk, (((1,), (0,)), ((), ())),
+        ds = p * (dp - dl_ref[0, 0][:, None])
+        dq_scr[...] += jax.lax.dot_general(
+            (ds * scale).astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == n_kb - 1)
@@ -274,16 +288,17 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, m_ref, li_ref, dl_ref,
     def _compute():
         qs, _, p = _recompute_p(q_ref, k_ref, m_ref, li_ref, q_start,
                                 k_start, block_q, block_k, t_real, scale)
-        do = do_ref[0].astype(jnp.float32)                # [bq, d]
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        do = do_ref[0]                                    # [bq, d]
+        v = v_ref[0]                                      # [bk, d]
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),              # pᵀ·do -> [bk, d]
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),  # pᵀ·do
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dl_ref[0][:, None])
+        ds = p * (dp - dl_ref[0, 0][:, None])
         dk_scr[...] += jax.lax.dot_general(
-            ds, qs, (((0,), (0,)), ((), ())),             # dsᵀ·qs -> [bk, d]
+            (ds * scale).astype(qs.dtype), qs,
+            (((0,), (0,)), ((), ())),                     # dsᵀ·qs -> [bk, d]
             preferred_element_type=jnp.float32)
 
     @pl.when(qb == n_qb - 1)
@@ -322,14 +337,14 @@ def _flash_bwd(block_q, block_k, res, do):
     dop = dop.reshape(bh, tq, dp_)
     kp = kp.reshape(bh, tk, dp_)
     vp = vp.reshape(bh, tk, dp_)
-    mp = mp.reshape(bh, tq)
-    linvp = linvp.reshape(bh, tq)
-    dlp = dlp.reshape(bh, tq)
+    mp = _rows_3d(mp, bh, tq)
+    linvp = _rows_3d(linvp, bh, tq)
+    dlp = _rows_3d(dlp, bh, tq)
     n_qb, n_kb = tq // block_q, tk // block_k
 
     q_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0))
     k_spec = pl.BlockSpec((1, block_k, dp_), lambda i, j, kb: (i, kb, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j))
     compiler_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
@@ -349,7 +364,7 @@ def _flash_bwd(block_q, block_k, res, do):
     # dkv grid: (bh, k-block, q-block) — index maps select by the axis kind
     kv_spec = pl.BlockSpec((1, block_k, dp_), lambda i, j, qb: (i, j, 0))
     qi_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, qb: (i, qb, 0))
-    rowi_spec = pl.BlockSpec((1, block_q), lambda i, j, qb: (i, qb))
+    rowi_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, qb: (i, 0, qb))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
                           t_real=t, scale=scale),

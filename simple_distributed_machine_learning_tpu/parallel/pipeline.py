@@ -115,7 +115,10 @@ class Pipeline:
     def __init__(self, stages: Sequence[Stage], mesh: jax.sharding.Mesh,
                  wire_dim: int, out_dim: int | tuple[int, ...],
                  n_microbatches: int = 1, compute_dtype=None,
-                 remat: bool = False):
+                 remat: bool = False, schedule: str = "gpipe"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self.schedule = schedule
         self.stages = list(stages)
         self.mesh = mesh
         self.n_stages = mesh.shape[STAGE_AXIS]
@@ -330,6 +333,25 @@ class Pipeline:
         return shard_shape[0]
 
     # ---- parameters -----------------------------------------------------
+
+    def replication_weights(self):
+        """``[S, n_model, n_expert, 1]`` float32 multipliers for squared-
+        gradient-norm sums over the packed buffer: stages stored redundantly
+        across the model/expert axes (``Stage.shards``/``expert_shards`` is
+        None) get ``1/replication`` so each parameter counts once in a global
+        norm (``train.optimizer.clip_by_global_norm``); genuinely sharded
+        rows count fully. Padding tail bytes are zero-gradient anyway."""
+        import numpy as np
+        w = np.ones((self.n_stages, self.n_model, self.n_expert, 1),
+                    np.float32)
+        for s, stage in enumerate(self.stages):
+            rep = 1
+            if stage.shards is None:
+                rep *= self.n_model
+            if stage.expert_shards is None:
+                rep *= self.n_expert
+            w[s] = 1.0 / rep
+        return w
 
     def param_spec(self) -> P:
         """PartitionSpec of the packed ``[n_stages, n_model, n_expert, P]``
@@ -647,6 +669,35 @@ class Pipeline:
         xw, tgt, w = self._prep_inputs(x, targets, weights)
         return self._shard_fn(deterministic, loss_only=True)(
             buf, xw, tgt, w, key)
+
+    def loss_and_grads(self, buf: jax.Array, x: jax.Array,
+                       targets: jax.Array, key: jax.Array,
+                       deterministic: bool = False,
+                       weights: jax.Array | None = None
+                       ) -> tuple[jax.Array, jax.Array]:
+        """Scalar loss + packed-buffer gradients — the training contract.
+
+        ``schedule='gpipe'`` (default): ``jax.value_and_grad`` over the
+        scanned loss-only engine (XLA reverses the scan; all ``M``
+        microbatch residuals are alive between the sweeps).
+        ``schedule='1f1b'``: the hand-scheduled interleave in ``onefb.py``
+        — same loss/gradients (parity-tested), activation memory bounded by
+        the topology ``S`` instead of ``M``.
+        """
+        if self.schedule == "1f1b" and not self._trivial_mesh():
+            from simple_distributed_machine_learning_tpu.parallel.onefb import (
+                build_1f1b_fn,
+            )
+            cache_key = ("1f1b", deterministic)
+            if cache_key not in self._sm_cache:
+                self._sm_cache[cache_key] = build_1f1b_fn(self, deterministic)
+            xw, tgt, w = self._prep_inputs(x, targets, weights)
+            return self._sm_cache[cache_key](buf, xw, tgt, w, key)
+
+        def loss_fn(b):
+            return self.loss(b, x, targets, key, deterministic=deterministic,
+                             weights=weights)
+        return jax.value_and_grad(loss_fn)(buf)
 
     def _trivial_mesh(self) -> bool:
         """Degenerate single-device mesh: the pipeline IS the fused model.

@@ -78,6 +78,17 @@ def unpack_stage_params(row: jax.Array, meta: StageMeta) -> Any:
     return jax.tree.unflatten(meta.treedef, leaves)
 
 
+def pack_stage_grads(tree: Any, meta: StageMeta, width: int) -> jax.Array:
+    """In-graph inverse of :func:`unpack_stage_params`: flatten a pytree with
+    ``meta``'s leaf order into a zero-padded ``[width]`` f32 row. Used by the
+    1F1B engine, whose hand-scheduled backward produces per-stage grad
+    pytrees that must ride the same packed layout as the param buffer."""
+    leaves = jax.tree.flatten(tree)[0]
+    flat = (jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
+            if leaves else jnp.zeros((0,), jnp.float32))
+    return jnp.pad(flat, (0, width - flat.shape[0]))
+
+
 def wire_encode(x: jax.Array, wire_dim: int) -> jax.Array:
     """Flatten per-sample features and zero-pad to the pipeline wire width."""
     flat = jnp.reshape(x, (x.shape[0], -1))

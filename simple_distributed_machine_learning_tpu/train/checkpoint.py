@@ -35,6 +35,46 @@ def _to_host(x) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
+def _gather_state(buf: jax.Array, opt_state: Any, step: int,
+                  extra: dict | None) -> tuple[dict, dict]:
+    """Device→host gather of the full training state (COLLECTIVE in
+    multi-process runs — every process must reach it, on its main thread)."""
+    arrays = {"params": _to_host(buf)}
+    opt_leaves, _ = jax.tree.flatten(opt_state)
+    for i, leaf in enumerate(opt_leaves):
+        arrays[f"opt_{i}"] = _to_host(leaf)
+    meta = {"step": int(step), "n_opt_leaves": len(opt_leaves),
+            "extra": extra or {}}
+    return arrays, meta
+
+
+def _write_npz(path: str, arrays: dict, meta: dict) -> None:
+    import tempfile
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    arrays = dict(arrays)
+    arrays["_meta_json"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    # unique temp name (not path + '.tmp'): two in-flight async saves to the
+    # same path must not interleave writes into one temp file — each writes
+    # its own and the atomic replace keeps whichever finished last whole
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)),
+                               prefix=os.path.basename(path) + ".tmp.",
+                               suffix=".npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)  # atomic: old checkpoint intact until whole
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta, f)
+
+
 def save_checkpoint(path: str, buf: jax.Array, opt_state: Any, step: int,
                     extra: dict | None = None) -> None:
     """Write training state to ``path`` (one .npz, atomically replaced).
@@ -48,22 +88,58 @@ def save_checkpoint(path: str, buf: jax.Array, opt_state: Any, step: int,
     non-addressable shards is a collective); only process 0 touches the
     filesystem.
     """
-    arrays = {"params": _to_host(buf)}
-    opt_leaves, _ = jax.tree.flatten(opt_state)
-    for i, leaf in enumerate(opt_leaves):
-        arrays[f"opt_{i}"] = _to_host(leaf)
+    arrays, meta = _gather_state(buf, opt_state, step, extra)
     if jax.process_index() != 0:
         return
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    meta = {"step": int(step), "n_opt_leaves": len(opt_leaves),
-            "extra": extra or {}}
-    arrays["_meta_json"] = np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8)
-    tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path)  # atomic: old checkpoint intact until the new is whole
-    with open(path + ".meta.json", "w") as f:
-        json.dump(meta, f)
+    _write_npz(path, arrays, meta)
+
+
+class AsyncSave:
+    """Handle for an in-flight async checkpoint write."""
+
+    def __init__(self, thread=None):
+        self._thread = thread
+        self._error: BaseException | None = None
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Block until the write completes; re-raise any write error."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("checkpoint write still in flight")
+        if self._error is not None:
+            raise self._error
+
+    @property
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+
+def save_checkpoint_async(path: str, buf: jax.Array, opt_state: Any,
+                          step: int, extra: dict | None = None) -> AsyncSave:
+    """Like :func:`save_checkpoint` but the FILE WRITE happens on a
+    background thread, so training resumes as soon as the device→host
+    gather is done (the gather itself stays on the caller's thread — it is
+    a collective in multi-process runs and must not race the train step's
+    collectives). Call ``.wait()`` on the returned handle before process
+    exit or before depending on the file."""
+    import threading
+
+    arrays, meta = _gather_state(buf, opt_state, step, extra)
+    handle = AsyncSave()
+    if jax.process_index() != 0:
+        return handle
+
+    def write():
+        try:
+            _write_npz(path, arrays, meta)
+        except BaseException as e:  # noqa: BLE001 - surfaced via wait()
+            handle._error = e
+
+    t = threading.Thread(target=write, name="ckpt-write", daemon=True)
+    handle._thread = t
+    t.start()
+    return handle
 
 
 def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None
@@ -94,14 +170,23 @@ def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None
 
     opt_state: Any = opt_leaves
     if opt_treedef_like is not None:
+        from jax.sharding import NamedSharding as _NS
+
+        def _place(ref, arr):
+            # re-place only leaves that carry a MESH sharding (momentum/
+            # moment buffers shaped like the packed param buffer). Scalar
+            # leaves — AdamW's step, a schedule's counter — come off
+            # opt.init as uncommitted single-device arrays; device_put-ing
+            # them to that device would COMMIT them and make the first
+            # jitted step reject the mixed placement against the mesh-
+            # sharded buffer. Left as host values, jit replicates them.
+            sh = getattr(ref, "sharding", None)
+            return jax.device_put(arr, sh) if isinstance(sh, _NS) else arr
+
         treedef = jax.tree.structure(opt_treedef_like)
         opt_state = jax.tree.unflatten(treedef, opt_leaves)
         if pipe is not None:
-            sharded = jax.tree.map(
-                lambda ref, arr: jax.device_put(arr, ref.sharding)
-                if hasattr(ref, "sharding") else arr,
-                opt_treedef_like, opt_state)
-            opt_state = sharded
+            opt_state = jax.tree.map(_place, opt_treedef_like, opt_state)
 
     return {"params": buf, "opt_state": opt_state, "step": meta["step"],
             "extra": meta["extra"]}

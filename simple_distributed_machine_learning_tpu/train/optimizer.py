@@ -26,33 +26,58 @@ class Optimizer(NamedTuple):
     update: Callable[[Any, Any, Any], tuple[Any, Any]]  # (grads, state, params) -> (new_params, new_state)
 
 
-def sgd(learning_rate: float, momentum: float = 0.0) -> Optimizer:
-    """torch-semantics SGD(momentum). State = momentum buffer (like-sharded)."""
+def sgd(learning_rate, momentum: float = 0.0) -> Optimizer:
+    """torch-semantics SGD(momentum). State = momentum buffer (like-sharded).
+
+    ``learning_rate`` may be a float (constant) or a :mod:`schedules`
+    Schedule (``step -> lr``); with a schedule the state grows a step
+    counter and the k-th update (0-indexed) runs at ``schedule(k)`` —
+    torch's ``opt.step(); sched.step()`` convention.
+    """
+    import jax.numpy as jnp
+
+    scheduled = callable(learning_rate)
 
     def init(params):
-        if momentum == 0.0:
-            return ()
-        return jax.tree.map(jax.numpy.zeros_like, params)
+        buf = (() if momentum == 0.0
+               else jax.tree.map(jnp.zeros_like, params))
+        if scheduled:
+            return (jnp.zeros((), jnp.int32), buf)
+        return buf
 
     def update(grads, state, params):
+        if scheduled:
+            count, buf = state
+            lr = learning_rate(count)
+            count = count + 1
+        else:
+            buf, lr = state, learning_rate
         if momentum == 0.0:
-            new_params = jax.tree.map(lambda p, g: p - learning_rate * g,
-                                      params, grads)
-            return new_params, ()
-        new_buf = jax.tree.map(lambda b, g: momentum * b + g, state, grads)
-        new_params = jax.tree.map(lambda p, b: p - learning_rate * b,
-                                  params, new_buf)
-        return new_params, new_buf
+            new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            new_buf = ()
+        else:
+            new_buf = jax.tree.map(lambda b, g: momentum * b + g, buf, grads)
+            new_params = jax.tree.map(lambda p, b: p - lr * b,
+                                      params, new_buf)
+        return new_params, ((count, new_buf) if scheduled else new_buf)
 
     return Optimizer(init, update)
 
 
-def adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+def adamw(learning_rate, b1: float = 0.9, b2: float = 0.999,
           eps: float = 1e-8, weight_decay: float = 0.01) -> Optimizer:
     """torch-semantics AdamW (decoupled weight decay, bias-corrected
     moments — torch.optim.AdamW's update rule). State = (step, m, v),
-    m/v like-sharded with the params."""
+    m/v like-sharded with the params.
+
+    ``learning_rate``: float or Schedule; a schedule reuses the existing
+    step counter (the k-th update runs at ``schedule(k)``) and scales both
+    the decoupled decay and the moment step, like torch's LambdaLR over
+    AdamW.
+    """
     import jax.numpy as jnp
+
+    scheduled = callable(learning_rate)
 
     def init(params):
         zeros = lambda: jax.tree.map(jnp.zeros_like, params)
@@ -60,6 +85,7 @@ def adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
 
     def update(grads, state, params):
         step, m, v = state
+        lr = learning_rate(step) if scheduled else learning_rate
         step = step + 1
         t = step.astype(jnp.float32)
         m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
@@ -70,13 +96,46 @@ def adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         def upd(p, m_, v_):
             # decoupled decay first (torch applies p *= 1 - lr*wd before the
             # Adam step), then the bias-corrected moment update
-            p = p * (1 - learning_rate * weight_decay)
-            return p - learning_rate * (m_ / bc1) / (
+            p = p * (1 - lr * weight_decay)
+            return p - lr * (m_ / bc1) / (
                 jnp.sqrt(v_ / bc2) + eps)
 
         return jax.tree.map(upd, params, m, v), (step, m, v)
 
     return Optimizer(init, update)
+
+
+def clip_by_global_norm(opt: Optimizer, max_norm: float,
+                        norm_weights: Any = None) -> Optimizer:
+    """Wrap ``opt`` with torch ``clip_grad_norm_`` semantics: compute the
+    global L2 norm over all gradient leaves and scale every gradient by
+    ``min(1, max_norm / (norm + 1e-6))`` before the inner update.
+
+    ``norm_weights``: optional per-leaf multiplier (broadcastable onto each
+    leaf) for the SQUARED-norm accumulation. The packed ``[S, M, E, P]``
+    pipeline buffer stores stages without tensor/expert shards redundantly
+    on every model/expert slot, and after ``grad_sync`` each slot carries
+    the FULL gradient — an unweighted norm would count those parameters
+    ``n_model * n_expert`` times. ``Pipeline.replication_weights()``
+    supplies the exact ``1/replication`` correction; on a tp=ep=1 mesh the
+    unweighted norm is already exact.
+    """
+    import jax.numpy as jnp
+
+    def update(grads, state, params):
+        leaves = jax.tree.leaves(grads)
+        wts = ([None] * len(leaves) if norm_weights is None
+               else jax.tree.leaves(norm_weights))
+        sq = jnp.float32(0.0)
+        for g, w in zip(leaves, wts):
+            g2 = g.astype(jnp.float32) ** 2
+            sq = sq + jnp.sum(g2 if w is None else g2 * w)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        grads = jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+        return opt.update(grads, state, params)
+
+    return Optimizer(opt.init, update)
 
 
 def shard_opt_state_zero1(state: Any, mesh, param_spec) -> Any:

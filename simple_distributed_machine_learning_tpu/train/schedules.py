@@ -1,0 +1,70 @@
+"""Learning-rate schedules: step-indexed lr for the compiled train step.
+
+The reference trains at a single constant lr (0.1, hardcoded at
+``/root/reference/simple_distributed.py:20,:103``); a framework needs decay
+and warmup. A schedule here is a pure function ``step -> lr`` evaluated
+INSIDE the jit'd optimizer update (``train/optimizer.py``): the step counter
+rides the optimizer state, so a scanned multi-step window (``bench.py``,
+``train/step.py::make_scanned_train_step``) decays correctly with no host
+involvement.
+
+Conventions match ``torch.optim.lr_scheduler`` stepped once per optimizer
+step: the k-th update (0-indexed) uses ``schedule(k)``, i.e. the first update
+runs at ``schedule(0)`` — exactly what torch's pattern
+``opt.step(); sched.step()`` produces (pinned against torch by
+``tests/test_schedules.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+# step (int32 scalar, 0-indexed) -> lr (float32 scalar)
+Schedule = Callable[[jax.Array], jax.Array]
+
+
+def constant(lr: float) -> Schedule:
+    def f(t):
+        return jnp.float32(lr)
+    return f
+
+
+def cosine(base_lr: float, total_steps: int,
+           final_frac: float = 0.0) -> Schedule:
+    """Cosine decay from ``base_lr`` to ``final_frac * base_lr`` over
+    ``total_steps`` (clamped there for any later steps)."""
+    total = max(int(total_steps), 1)
+
+    def f(t):
+        frac = jnp.clip(t.astype(jnp.float32) / total, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.float32(base_lr) * (final_frac + (1.0 - final_frac) * cos)
+    return f
+
+
+def warmup_cosine(base_lr: float, warmup_steps: int, total_steps: int,
+                  final_frac: float = 0.0) -> Schedule:
+    """Linear warmup 0 -> base over ``warmup_steps`` (the k-th update at
+    ``base * (k+1)/warmup``), then cosine decay over the remaining steps."""
+    warm = max(int(warmup_steps), 0)
+    decay = cosine(base_lr, max(int(total_steps) - warm, 1), final_frac)
+
+    def f(t):
+        tf = t.astype(jnp.float32)
+        wu = jnp.float32(base_lr) * (tf + 1.0) / max(warm, 1)
+        return jnp.where(t < warm, wu, decay(t - warm))
+    return f
+
+
+def step_decay(base_lr: float, step_size: int,
+               gamma: float = 0.1) -> Schedule:
+    """torch ``StepLR``: lr = base * gamma^floor(t / step_size)."""
+    size = max(int(step_size), 1)
+
+    def f(t):
+        return jnp.float32(base_lr) * jnp.float32(gamma) ** (
+            (t // size).astype(jnp.float32))
+    return f

@@ -29,12 +29,13 @@ def make_train_step(pipe: Pipeline, opt: Optimizer):
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(buf, opt_state, x, targets, key, weights=None):
-        def loss_fn(b):
-            # Pipeline.loss: the loss-only engine — no [batch, *out_shape]
-            # log-probs accumulator rides the scan carry during training
-            return pipe.loss(b, x, targets, key, deterministic=False,
-                             weights=weights)
-        loss, grads = jax.value_and_grad(loss_fn)(buf)
+        # Pipeline.loss_and_grads: GPipe via value_and_grad of the loss-only
+        # engine (no [batch, *out_shape] accumulator rides the scan), or the
+        # hand-scheduled 1F1B interleave when the pipeline was built with
+        # schedule='1f1b'
+        loss, grads = pipe.loss_and_grads(buf, x, targets, key,
+                                          deterministic=False,
+                                          weights=weights)
         buf2, opt_state2 = opt.update(grads, opt_state, buf)
         return buf2, opt_state2, loss
 
@@ -153,10 +154,8 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1,
             b, s, i = carry
             x, t = batch
             k = jax.random.fold_in(key, i)
-
-            def loss_fn(bb):
-                return pipe.loss(bb, x, t, k, deterministic=False)
-            loss, grads = jax.value_and_grad(loss_fn)(b)
+            loss, grads = pipe.loss_and_grads(b, x, t, k,
+                                              deterministic=False)
             b2, s2 = opt.update(grads, s, b)
             return (b2, s2, i + 1), loss
 

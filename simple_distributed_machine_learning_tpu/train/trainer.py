@@ -57,9 +57,16 @@ class TrainConfig:
     # written after every epoch and auto-resumed from on construction
     checkpoint_dir: str | None = None
     resume: bool = True
+    # overlap the checkpoint FILE WRITE with the next epoch's compute (the
+    # device->host gather stays synchronous — it is a collective)
+    async_checkpoint: bool = False
     # ZeRO-1: shard optimizer state over the data axis (pure sharding
     # annotation; GSPMD inserts the collectives — optimizer.py)
     zero1: bool = False
+    # seeded per-epoch shuffle of the train set (the reference trains in
+    # fixed order, simple_distributed.py:94-95 — kept as the default for
+    # loss-curve parity)
+    shuffle: bool = False
 
 
 class Trainer:
@@ -82,6 +89,7 @@ class Trainer:
         self._eval_step = make_eval_step(pipe)
         self._key = jax.random.key(self.config.seed)
         self._step_count = 0
+        self._pending_save = None
         self.start_epoch = 1
         self.is_main = jax.process_index() == 0
         if self.config.checkpoint_dir and self.config.resume:
@@ -132,11 +140,19 @@ class Trainer:
             return
         from simple_distributed_machine_learning_tpu.train.checkpoint import (
             save_checkpoint,
+            save_checkpoint_async,
         )
         # every process participates: gathering non-addressable shards is a
         # collective inside save_checkpoint; only process 0 writes the file
-        save_checkpoint(self._ckpt_path(), self.buf, self.opt_state,
-                        self._step_count, extra={"epoch": epoch})
+        if self.config.async_checkpoint:
+            if self._pending_save is not None:
+                self._pending_save.wait()    # one write in flight at a time
+            self._pending_save = save_checkpoint_async(
+                self._ckpt_path(), self.buf, self.opt_state,
+                self._step_count, extra={"epoch": epoch})
+        else:
+            save_checkpoint(self._ckpt_path(), self.buf, self.opt_state,
+                            self._step_count, extra={"epoch": epoch})
 
     # -- reference console surface (simple_distributed.py:114-117,:130-132) --
 
@@ -152,8 +168,10 @@ class Trainer:
         loss = 0.0
         # batch assembly on the native C++ prefetcher thread when available
         # (transparent python fallback), overlapped with the device step
+        shuffle_seed = (cfg.seed * 100003 + epoch) if cfg.shuffle else None
         for batch_idx, b in enumerate(
-                prefetch_batches(self.train_ds, cfg.batch_size)):
+                prefetch_batches(self.train_ds, cfg.batch_size,
+                                 shuffle_seed=shuffle_seed)):
             key = jax.random.fold_in(self._key, self._step_count)
             # ragged final batch: zero-padded, masked out of the loss mean
             # (the reference just trains on the short batch, :108-113; the
@@ -206,3 +224,5 @@ class Trainer:
             self.train_epoch(epoch)
             self.evaluate()
             self._save(epoch)
+        if self._pending_save is not None:
+            self._pending_save.wait()

@@ -69,3 +69,101 @@ def test_restore_rejects_mismatched_buffer_shape(tmp_path):
                     opt_state=[], step=1)
     with pytest.raises(ValueError, match="does not match the model"):
         restore_checkpoint(path, pipe=pipe)
+
+
+def test_async_save_round_trips_bit_exact(tmp_path):
+    """save_checkpoint_async: same file contents as the sync path, write
+    overlapped on a background thread, errors surfaced via wait()."""
+    import pytest
+
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        save_checkpoint_async,
+    )
+
+    key = jax.random.key(0)
+    stages, wd, od = make_mlp_stages(key, [12, 16, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wd, od)
+    buf = pipe.init_params()
+    opt = sgd(0.1, 0.5)
+    state = opt.init(buf)
+
+    path = str(tmp_path / "async.npz")
+    h = save_checkpoint_async(path, buf, state, step=7, extra={"epoch": 2})
+    h.wait()
+    assert h.done and os.path.exists(path)
+    ck = restore_checkpoint(path, pipe=pipe, opt_treedef_like=state)
+    assert ck["step"] == 7 and ck["extra"]["epoch"] == 2
+    np.testing.assert_array_equal(np.asarray(jax.device_get(buf)),
+                                  np.asarray(jax.device_get(ck["params"])))
+
+    # a failing write must raise from wait(), not vanish on the thread
+    bad = save_checkpoint_async(str(tmp_path / "nodir" / ("x" * 300) / "y.npz"),
+                                buf, state, step=0)
+    with pytest.raises(BaseException):
+        bad.wait()
+
+
+def test_trainer_async_checkpoint_resumes(tmp_path):
+    """Trainer(async_checkpoint=True): the per-epoch save lands on disk and
+    a fresh Trainer auto-resumes from it."""
+    from simple_distributed_machine_learning_tpu.data.mnist import Dataset
+    from simple_distributed_machine_learning_tpu.train.trainer import (
+        TrainConfig,
+        Trainer,
+    )
+
+    rng = np.random.RandomState(0)
+    ds = Dataset(rng.randn(120, 12).astype(np.float32),
+                 rng.randint(0, 10, 120))
+    key = jax.random.key(0)
+    stages, wd, od = make_mlp_stages(key, [12, 16, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wd, od)
+    cfg = TrainConfig(epochs=2, batch_size=30, checkpoint_dir=str(tmp_path),
+                      async_checkpoint=True, print_throughput=False)
+    tr = Trainer(pipe, ds, ds, cfg)
+    tr.fit()
+    assert os.path.exists(str(tmp_path / "state.npz"))
+
+    pipe2 = Pipeline(stages, mesh, wd, od)
+    tr2 = Trainer(pipe2, ds, ds, cfg)
+    assert tr2.start_epoch == 3
+    np.testing.assert_array_equal(np.asarray(jax.device_get(tr.buf)),
+                                  np.asarray(jax.device_get(tr2.buf)))
+
+
+def test_resume_scalar_opt_state_on_multidevice_mesh():
+    """Scalar optimizer-state leaves (a schedule's step counter, AdamW's
+    step) must come back PLACEABLE after restore: committing them to the
+    single device opt.init happened to use makes the first jitted step
+    reject the mixed placement against the mesh-sharded buffer (caught by
+    driving CLI resume; regression for train/checkpoint.py::_place)."""
+    from simple_distributed_machine_learning_tpu.train import schedules
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        save_checkpoint,
+    )
+    from simple_distributed_machine_learning_tpu.train.optimizer import adamw
+
+    key = jax.random.key(0)
+    stages, wd, od = make_mlp_stages(key, [12, 16, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    x = jax.random.normal(key, (8, 12))
+    y = jax.random.randint(key, (8,), 0, 10)
+
+    for opt in (sgd(schedules.cosine(0.1, 50), 0.5), adamw(1e-3)):
+        pipe = Pipeline(stages, mesh, wd, od)
+        buf = pipe.init_params()
+        state = opt.init(buf)
+        step = make_train_step(pipe, opt)
+        buf, state, _ = step(buf, state, x, y, key)
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "s.npz")
+            save_checkpoint(path, buf, state, step=1)
+            ck = restore_checkpoint(path, pipe=pipe,
+                                    opt_treedef_like=opt.init(
+                                        pipe.init_params()))
+            step2 = make_train_step(pipe, opt)
+            b2, s2, loss = step2(ck["params"], ck["opt_state"], x, y, key)
+            assert np.isfinite(float(loss))

@@ -1,0 +1,222 @@
+"""LR schedules + gradient clipping: math, torch parity, CLI wiring.
+
+The reference trains at one constant lr (simple_distributed.py:20,:103);
+these are framework extensions, pinned against torch's lr_scheduler /
+clip_grad_norm_ semantics so a torch user gets identical trajectories.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.train import schedules
+from simple_distributed_machine_learning_tpu.train.optimizer import (
+    adamw,
+    clip_by_global_norm,
+    sgd,
+)
+
+
+def test_cosine_matches_closed_form():
+    sched = schedules.cosine(0.5, 100, final_frac=0.1)
+    for t in [0, 1, 37, 99, 100, 250]:
+        frac = min(t / 100, 1.0)
+        want = 0.5 * (0.1 + 0.9 * 0.5 * (1 + math.cos(math.pi * frac)))
+        assert float(sched(jnp.int32(t))) == pytest.approx(want, rel=1e-6)
+
+
+def test_warmup_then_cosine():
+    sched = schedules.warmup_cosine(1.0, 10, 110)
+    # linear ramp: k-th update at (k+1)/warmup
+    assert float(sched(jnp.int32(0))) == pytest.approx(0.1)
+    assert float(sched(jnp.int32(9))) == pytest.approx(1.0)
+    # then cosine over the remaining 100 steps
+    assert float(sched(jnp.int32(10))) == pytest.approx(1.0)
+    assert float(sched(jnp.int32(60))) == pytest.approx(0.5, rel=1e-6)
+    assert float(sched(jnp.int32(110))) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_step_decay():
+    sched = schedules.step_decay(0.1, 30, gamma=0.5)
+    assert float(sched(jnp.int32(29))) == pytest.approx(0.1)
+    assert float(sched(jnp.int32(30))) == pytest.approx(0.05)
+    assert float(sched(jnp.int32(90))) == pytest.approx(0.0125)
+
+
+def _run_ours(opt, params, grads_seq):
+    state = opt.init(params)
+    out = []
+    for g in grads_seq:
+        params, state = opt.update(g, state, params)
+        out.append(jax.tree.map(np.asarray, params))
+    return out
+
+
+def test_scheduled_constant_equals_plain_sgd():
+    key = jax.random.key(0)
+    params = {"w": jax.random.normal(key, (4, 3)), "b": jnp.ones((3,))}
+    grads_seq = [jax.tree.map(lambda a: a * (i + 1) * 0.01, params)
+                 for i in range(5)]
+    plain = _run_ours(sgd(0.1, 0.5), params, grads_seq)
+    sched = _run_ours(sgd(schedules.constant(0.1), 0.5), params, grads_seq)
+    for p, s in zip(plain, sched):
+        np.testing.assert_allclose(p["w"], s["w"], rtol=1e-6)
+
+
+def test_sgd_cosine_matches_torch_lambdalr():
+    """torch SGD(momentum) + LambdaLR(cosine), identical grads both sides:
+    per-step parameter trajectories must match."""
+    import torch
+
+    steps, total = 12, 12
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(5, 4).astype(np.float32)
+    grads = [rng.randn(5, 4).astype(np.float32) for _ in range(steps)]
+
+    def lam(k):  # torch multiplies base_lr by lam(epoch)
+        return 0.5 * (1 + math.cos(math.pi * min(k / total, 1.0)))
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.SGD([tw], lr=0.2, momentum=0.5)
+    tsched = torch.optim.lr_scheduler.LambdaLR(topt, lam)
+    torch_traj = []
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        topt.step()
+        tsched.step()
+        torch_traj.append(tw.detach().numpy().copy())
+
+    ours = _run_ours(sgd(schedules.cosine(0.2, total), 0.5),
+                     jnp.asarray(w0), [jnp.asarray(g) for g in grads])
+    for t_w, o_w in zip(torch_traj, ours):
+        np.testing.assert_allclose(t_w, o_w, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_schedule_scales_first_step():
+    params = jnp.ones((3,))
+    g = jnp.full((3,), 0.5)
+    # schedule(0) = 0 -> first update must be a no-op (decay scaled too)
+    opt = adamw(schedules.step_decay(0.0, 10), weight_decay=0.1)
+    p1, _ = opt.update(g, opt.init(params), params)
+    np.testing.assert_allclose(np.asarray(p1), np.ones((3,)), rtol=1e-7)
+
+
+def test_clip_matches_torch_clip_grad_norm():
+    import torch
+
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(6, 2).astype(np.float32)
+    grads = [rng.randn(6, 2).astype(np.float32) * s for s in (5.0, 0.01, 2.0)]
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.SGD([tw], lr=0.1, momentum=0.5)
+    torch_traj = []
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        torch.nn.utils.clip_grad_norm_([tw], max_norm=1.0)
+        topt.step()
+        torch_traj.append(tw.detach().numpy().copy())
+
+    opt = clip_by_global_norm(sgd(0.1, 0.5), 1.0)
+    ours = _run_ours(opt, jnp.asarray(w0), [jnp.asarray(g) for g in grads])
+    for t_w, o_w in zip(torch_traj, ours):
+        np.testing.assert_allclose(t_w, o_w, rtol=1e-5, atol=1e-6)
+
+
+def test_clip_norm_weights_discount_replicas():
+    """With 1/replication weights, a doubled (replicated) gradient clips to
+    the same scale as the single copy."""
+    g = jnp.full((4,), 3.0)                  # norm 6
+    stacked = jnp.stack([g, g])              # replicated twice: raw norm 6*sqrt2
+    w = jnp.full((2, 1), 0.5)                # replication_weights analogue
+
+    applied = {}
+
+    def capture_update(grads, state, params):
+        applied["g"] = grads
+        return params, state
+
+    from simple_distributed_machine_learning_tpu.train.optimizer import (
+        Optimizer,
+    )
+    inner = Optimizer(lambda p: (), capture_update)
+    clip_by_global_norm(inner, 1.0, norm_weights=w).update(
+        stacked, (), stacked)
+    # weighted norm = 6 -> scale 1/6 (unweighted would give 1/(6*sqrt2))
+    np.testing.assert_allclose(np.asarray(applied["g"][0]),
+                               np.asarray(g) / 6.0, rtol=1e-4)
+
+
+def test_pipeline_replication_weights():
+    from simple_distributed_machine_learning_tpu.models.mlp import (
+        make_mlp_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+        make_mlp_tp_stages,
+    )
+
+    # plain stages on a tp=2 mesh are stored twice -> weight 1/2
+    stages, wire, out = make_mlp_tp_stages(jax.random.key(0),
+                                           [8, 16, 16, 16, 4], 2, 2)
+    mesh = make_mesh(n_stages=2, n_model=2)
+    pipe = Pipeline(stages, mesh, wire, out)
+    w = pipe.replication_weights()
+    assert w.shape == (2, 2, 1, 1)
+    # TP stages carry real shards: each param counts once
+    np.testing.assert_allclose(w, 1.0)
+
+    stages2, wire2, out2 = make_mlp_stages(jax.random.key(0), [8, 6, 4], 2)
+    pipe2 = Pipeline(stages2, mesh, wire2, out2)
+    np.testing.assert_allclose(pipe2.replication_weights(), 0.5)
+
+
+def test_scheduled_sgd_through_pipeline_train_step():
+    """End to end: a scheduled+clipped optimizer drives the compiled pipeline
+    step; loss decreases and the step counter advances."""
+    from simple_distributed_machine_learning_tpu.models.mlp import (
+        make_mlp_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+    from simple_distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+    )
+
+    stages, wire, out = make_mlp_stages(jax.random.key(0), [16, 12, 4], 2)
+    mesh = make_mesh(n_stages=2)
+    pipe = Pipeline(stages, mesh, wire, out)
+    opt = clip_by_global_norm(
+        sgd(schedules.warmup_cosine(0.1, 3, 20), 0.5), 1.0,
+        pipe.replication_weights())
+    buf = pipe.init_params()
+    state = opt.init(buf)
+    step = make_train_step(pipe, opt)
+    x = jax.random.normal(jax.random.key(1), (8, 16))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 4)
+    losses = []
+    for i in range(10):
+        buf, state, loss = step(buf, state, x, y, jax.random.key(3))
+        losses.append(float(loss))
+    count, _ = state
+    assert int(count) == 10
+    assert losses[-1] < losses[0]
+
+
+def test_cli_schedule_and_clip(capsys):
+    from simple_distributed_machine_learning_tpu.cli import main
+
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--mlp-dims", "784,32,10", "--stages", "2", "--epochs", "1",
+          "--data-root", "/nonexistent", "--lr-schedule", "warmup-cosine",
+          "--warmup-steps", "5", "--clip-norm", "1.0"])
+    out = capsys.readouterr().out
+    assert "Test set: Average loss:" in out
