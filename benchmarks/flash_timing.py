@@ -26,10 +26,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "flash_timing.json")
 
-B, H, DH = 4, 8, 64
-ROWS = [(1024, "float32"), (1024, "bfloat16"),
-        (2048, "float32"), (2048, "bfloat16"),
-        (4096, "bfloat16")]
+B, H = 4, 8
+# (T, dh, dtype): dh=64 pays 2x lane padding on the MXU (the kernel pads the
+# head dim to 128 lanes) — dh=128 rows show the kernel at its natural tile
+ROWS = [(1024, 64, "float32"), (1024, 64, "bfloat16"),
+        (2048, 64, "float32"), (2048, 64, "bfloat16"),
+        (4096, 64, "bfloat16"),
+        (2048, 128, "bfloat16"), (4096, 128, "bfloat16"),
+        (8192, 128, "bfloat16")]
 REPS = 20
 
 
@@ -66,10 +70,10 @@ def main() -> None:
     )
 
     rows = []
-    for t, dtype in ROWS:
+    for t, dh, dtype in ROWS:
         key = jax.random.key(0)
         kq, kk, kv = jax.random.split(key, 3)
-        shape = (B, H, t, DH)
+        shape = (B, H, t, dh)
         dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
         q = jax.random.normal(kq, shape).astype(dt)
         k = jax.random.normal(kk, shape).astype(dt)
@@ -84,19 +88,25 @@ def main() -> None:
         dense = jax.jit(functools.partial(fwd_bwd, _dense_core))
         flash = jax.jit(functools.partial(fwd_bwd, flash_attention))
 
-        # parity first: the timing is meaningless if the values diverge
-        ld, gd = dense(q, k, v)
         lf, gf = flash(q, k, v)
-        rel = abs(float(ld) - float(lf)) / max(abs(float(ld)), 1e-9)
-        assert rel < (5e-2 if dtype == "bfloat16" else 1e-3), \
-            f"T={t} {dtype}: loss mismatch dense={float(ld)} flash={float(lf)}"
-
-        dense_ms = _time(dense, q, k, v)
+        try:
+            # parity first: the timing is meaningless if the values diverge
+            ld, gd = dense(q, k, v)
+            rel = abs(float(ld) - float(lf)) / max(abs(float(ld)), 1e-9)
+            assert rel < (5e-2 if dtype == "bfloat16" else 1e-3), \
+                f"T={t} {dtype}: loss mismatch {float(ld)} vs {float(lf)}"
+            dense_ms = _time(dense, q, k, v)
+        except Exception as e:  # noqa: BLE001 - dense OOM at long T is the
+            # flash kernel's memory win, record it instead of dying
+            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
+                raise
+            dense_ms = None
         flash_ms = _time(flash, q, k, v)
-        row = {"t": t, "dtype": dtype, "b": B, "h": H, "dh": DH,
-               "dense_ms": round(dense_ms, 3),
+        row = {"t": t, "dtype": dtype, "b": B, "h": H, "dh": dh,
+               "dense_ms": round(dense_ms, 3) if dense_ms else "OOM",
                "flash_ms": round(flash_ms, 3),
-               "speedup": round(dense_ms / flash_ms, 2),
+               "speedup": (round(dense_ms / flash_ms, 2) if dense_ms
+                           else None),
                "device": jax.devices()[0].device_kind}
         rows.append(row)
         print(json.dumps(row))

@@ -24,10 +24,23 @@ Both hops ride ``lax.ppermute`` rings in opposite directions inside one
 come out packed in the param buffer's ``[S, 1, 1, P]`` layout, ready for
 the owner-local optimizer update (no autodiff through the scan at all).
 
-Scope (v1): meshes with stage and data axes only (no tensor/expert/seq
-shards); dense stages, including aux-loss (dense-MoE) stages. The reference
+Scope: stage x data x seq meshes (sequence parallelism composes — ring /
+Ulysses collectives inside stage applies transpose under the vjp, and the
+pullback's implicit psum extends to the seq axis since params are
+seq-invariant); tensor/expert shards still route to the GPipe engine.
+Dense stages including aux-loss (dense-MoE) stages. The reference
 has no analogue of any of this — its two-stage "schedule" is one blocking
 RPC per batch with zero overlap (``simple_distributed.py:49``, SURVEY §3.3).
+
+CPU-backend caveat (virtual-device testing only): with seq parallelism the
+per-tick collective density is high enough that XLA:CPU's in-process
+rendezvous (hard 40 s deadline per collective) can abort under thread
+starvation on few-core machines — a runtime artifact, not a collective-
+order divergence (each device's collective sequence is identical to the
+GPipe engine's, which runs the same ring/Ulysses ops in the same
+stage-dispatched branches). TPU lowers these to ICI collective-permutes
+with no thread rendezvous. tests/test_onefb.py isolates and retries
+accordingly.
 """
 
 from __future__ import annotations
@@ -42,6 +55,7 @@ from simple_distributed_machine_learning_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     STAGE_AXIS,
 )
 from simple_distributed_machine_learning_tpu.parallel.staging import (
@@ -59,11 +73,16 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     ``grads`` shaped/sharded like the packed param buffer. Inputs are the
     ``Pipeline._prep_inputs`` layout.
     """
-    if pipe.n_model > 1 or pipe.n_expert > 1 or pipe.n_seq > 1:
+    if pipe.n_model > 1 or pipe.n_expert > 1:
         raise ValueError(
             "the 1F1B schedule currently supports stage+data meshes only "
             f"(got model={pipe.n_model}, expert={pipe.n_expert}, "
-            f"seq={pipe.n_seq}); use schedule='gpipe' for tp/ep/sp runs")
+            f"seq={pipe.n_seq}); use schedule='gpipe' for tp/ep runs")
+    if pipe.n_seq > 1 and len(pipe.out_shape) < 2:
+        raise ValueError(
+            "1F1B on a seq-parallel mesh needs a per-token output shape "
+            "(a classifier has no token axis to shard); use "
+            "schedule='gpipe'")
     if pipe.n_stages < 2:
         raise ValueError("1F1B needs >= 2 pipeline stages")
 
@@ -86,12 +105,22 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
         _pvary_to,
     )
 
+    # sequence parallelism: the token axis of the wire, targets and logits
+    # is sharded over the seq axis (stage in_shapes/wire_dim are per-shard,
+    # the Pipeline convention); stage applies do their own cross-token
+    # mixing via ring/Ulysses collectives, which jax.vjp transposes
+    seq_on = pipe.n_seq > 1
     # the mesh always carries all five named axes (size 1 when unused); the
     # param row varies over stage/model/expert via its sharding, inputs over
-    # data — match the GPipe engine's vma discipline exactly
+    # data (and seq when the token axis is sharded) — match the GPipe
+    # engine's vma discipline exactly
     vary_axes = (DATA_AXIS, STAGE_AXIS, MODEL_AXIS) + (
+        (SEQ_AXIS,) if seq_on else ()) + (
         (EXPERT_AXIS,) if pipe._has_expert else ())
-    vary_axes_nodata = vary_axes[1:]
+    # grad rows come out of the pullback invariant over data AND seq (the
+    # implicit psums — params are invariant over both)
+    vary_axes_nodata = tuple(a for a in vary_axes
+                             if a not in (DATA_AXIS, SEQ_AXIS))
 
     def per_device(row4d, x_mb, tgt_mb, w_mb, key):
         row = row4d[0, 0, 0]
@@ -108,7 +137,11 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
         def stage_key(m):
             k = jax.random.fold_in(
                 jax.random.fold_in(key, m), stage)
-            return jax.random.fold_in(k, lax.axis_index(DATA_AXIS))
+            k = jax.random.fold_in(k, lax.axis_index(DATA_AXIS))
+            if seq_on:
+                # distinct dropout noise per seq shard (GPipe does the same)
+                k = jax.random.fold_in(k, lax.axis_index(SEQ_AXIS))
+            return k
 
         def stage_fn(s):
             """The pure per-microbatch stage function the backward vjp's:
@@ -131,7 +164,7 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                 if isinstance(y, tuple):
                     y, aux = y
                     aux = aux.astype(jnp.float32)
-                obj = aux / (M * n_data)
+                obj = aux / (M * n_data * (pipe.n_seq if seq_on else 1))
                 num_raw = jnp.float32(0.0)
                 if is_last:
                     nll = nll_loss(y.astype(jnp.float32), tgt, "none")
@@ -237,6 +270,12 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
 
             # ---- the two rings -----------------------------------------
             wire_f = lax.ppermute(out_f, STAGE_AXIS, fwd_ring)
+            # serialize the reverse hop behind the forward one: the two are
+            # data-independent, and letting the runtime float both (plus the
+            # branch collectives) concurrently starves XLA:CPU's in-process
+            # rendezvous on few-core machines; a single token dependency
+            # bounds in-flight collectives at no cost to compute overlap
+            wire_f, d_x = lax.optimization_barrier((wire_f, d_x))
             wire_b = lax.ppermute(d_x, STAGE_AXIS, bwd_ring)
             return (wire_f, wire_b, inbuf, grad_acc, num_acc, aux_acc), None
 
@@ -254,6 +293,9 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
         # loss value (reporting): identical reduction to the GPipe engine
         num = lax.psum(lax.psum(num_acc, STAGE_AXIS), DATA_AXIS)
         aux = lax.pmean(lax.psum(aux_acc, STAGE_AXIS) / M, DATA_AXIS)
+        if seq_on:
+            num = lax.psum(num, SEQ_AXIS)
+            aux = lax.pmean(aux, SEQ_AXIS)
         loss = num / jnp.maximum(den_g, 1e-12) + aux
         loss = lax.pmean(loss, MODEL_AXIS)
         if pipe._has_expert:
@@ -265,12 +307,16 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
 
     from jax.sharding import PartitionSpec as P
 
-    # LM targets carry token axes ([M, mb, T]): extra unsharded dims
-    tgt_tok = (None,) * (len(out_shape) - 1)
+    # LM targets carry token axes ([M, mb, T]); on a seq mesh the wire's
+    # feature axis and the targets' token axis are sharded over it (the
+    # host packs one contiguous wire chunk per seq shard, _prep_inputs)
+    seq_or_none = SEQ_AXIS if seq_on else None
+    tok_axes = len(out_shape) - 1
+    tgt_tok = ((seq_or_none,) + (None,) * (tok_axes - 1)) if tok_axes else ()
     return jax.shard_map(
         per_device,
         mesh=pipe.mesh,
-        in_specs=(pipe.param_spec(), P(None, DATA_AXIS, None),
+        in_specs=(pipe.param_spec(), P(None, DATA_AXIS, seq_or_none),
                   P(None, DATA_AXIS, *tgt_tok), P(None, DATA_AXIS), P()),
         out_specs=(P(), pipe.param_spec()),
     )

@@ -307,3 +307,22 @@ def test_four_process_dp_pp(tmp_path):
         assert "Train Epoch" not in r.stdout
     assert "Train Epoch: 1" in rs[0].stdout
     assert "Test set: Average loss:" in rs[0].stdout
+
+
+def test_two_process_launch_1f1b(tmp_path):
+    """The 1F1B interleaved schedule across a REAL process boundary: both
+    rings (forward activations, backward cotangents) cross the gloo
+    transport every tick, with the scheduled+clipped optimizer in the same
+    compiled step."""
+    r0, r1 = run_two_ranks([
+        "--model", "mlp", "--mlp-dims", "784,64,10", "--epochs", "1",
+        "--microbatches", "4", "--schedule", "1f1b",
+        "--lr-schedule", "warmup-cosine", "--warmup-steps", "10",
+        "--clip-norm", "1.0",
+        "--data-root", str(tmp_path / "nodata"),
+    ])
+    assert r0.returncode == 0, f"rank0 failed:\n{r0.stderr[-3000:]}"
+    assert r1.returncode == 0, f"rank1 failed:\n{r1.stderr[-3000:]}"
+    assert "Test set: Average loss:" in r0.stdout
+    last = [ln for ln in r0.stdout.splitlines() if "Loss:" in ln][-1]
+    assert "nan" not in last.lower()
