@@ -24,11 +24,17 @@ Both hops ride ``lax.ppermute`` rings in opposite directions inside one
 come out packed in the param buffer's ``[S, 1, 1, P]`` layout, ready for
 the owner-local optimizer update (no autodiff through the scan at all).
 
-Scope: stage x data x seq meshes (sequence parallelism composes — ring /
-Ulysses collectives inside stage applies transpose under the vjp, and the
-pullback's implicit psum extends to the seq axis since params are
-seq-invariant); tensor/expert shards still route to the GPipe engine.
-Dense stages including aux-loss (dense-MoE) stages. The reference
+Scope: stage x data x seq x model meshes. Sequence parallelism composes
+(ring / Ulysses collectives inside stage applies transpose under the vjp;
+the pullback's implicit psum extends to the seq axis since params are
+seq-invariant). Tensor parallelism composes too: wires are typed model-
+INVARIANT, so a TP stage's pullback assembles its per-shard partial input
+cotangents via the same implicit psum, while replicated stages' pullbacks
+are rescaled by 1/n_model (they would otherwise sum n identical full
+cotangents); every model slot ends up holding the full gradient for its
+row, matching the GPipe engine bit-exactly on full-TP pipelines. Expert
+(MoE-sharded) meshes still route to the GPipe engine. Dense stages
+including aux-loss (dense-MoE) stages. The reference
 has no analogue of any of this — its two-stage "schedule" is one blocking
 RPC per batch with zero overlap (``simple_distributed.py:49``, SURVEY §3.3).
 
@@ -73,11 +79,10 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     ``grads`` shaped/sharded like the packed param buffer. Inputs are the
     ``Pipeline._prep_inputs`` layout.
     """
-    if pipe.n_model > 1 or pipe.n_expert > 1:
+    if pipe.n_expert > 1:
         raise ValueError(
-            "the 1F1B schedule currently supports stage+data meshes only "
-            f"(got model={pipe.n_model}, expert={pipe.n_expert}, "
-            f"seq={pipe.n_seq}); use schedule='gpipe' for tp/ep runs")
+            "the 1F1B schedule does not support expert-parallel meshes yet "
+            f"(expert={pipe.n_expert}); use schedule='gpipe' for ep runs")
     if pipe.n_seq > 1 and len(pipe.out_shape) < 2:
         raise ValueError(
             "1F1B on a seq-parallel mesh needs a per-token output shape "
@@ -110,6 +115,10 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     # the Pipeline convention); stage applies do their own cross-token
     # mixing via ring/Ulysses collectives, which jax.vjp transposes
     seq_on = pipe.n_seq > 1
+    tp_on = pipe.n_model > 1
+    n_model = pipe.n_model
+    # which stages carry REAL tensor shards (vs redundant replicas)
+    model_sharded = [s.shards is not None for s in pipe.stages]
     # the mesh always carries all five named axes (size 1 when unused); the
     # param row varies over stage/model/expert via its sharding, inputs over
     # data (and seq when the token axis is sharded) — match the GPipe
@@ -121,6 +130,15 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     # implicit psums — params are invariant over both)
     vary_axes_nodata = tuple(a for a in vary_axes
                              if a not in (DATA_AXIS, SEQ_AXIS))
+    # tensor parallelism: activations on the wire are logically REPLICATED
+    # over the model axis (a TP stage ends each column->row pair in its own
+    # psum; a replicated stage computes redundantly). Typing the wires
+    # model-INVARIANT makes the vjp pullback's implicit psum over 'model'
+    # assemble the true input cotangent for TP stages (sum of per-shard
+    # partials); replicated stages' pullbacks then overcount by n_model
+    # (n identical full cotangents summed) and are rescaled below.
+    wire_axes = (tuple(a for a in vary_axes if a != MODEL_AXIS)
+                 if tp_on else vary_axes)
 
     def per_device(row4d, x_mb, tgt_mb, w_mb, key):
         row = row4d[0, 0, 0]
@@ -178,11 +196,23 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                 return out, obj, num_raw, aux
             return fn
 
+        def _to_wire_type(v):
+            """Normalize an activation to the wire's vma: a replicated
+            stage's output is typed model-varying (its param row is) with
+            REPLICATED values — pmean over 'model' is the identity-valued
+            replication proof that drops the axis (the GPipe engine's
+            logits/num trick); then pvary any missing axes."""
+            if tp_on:
+                have = getattr(jax.typeof(v), "vma", frozenset())
+                if MODEL_AXIS in have:
+                    v = lax.pmean(v, MODEL_AXIS)
+            return _pvary_to(v, wire_axes)
+
         def make_fwd_branch(s):
             def branch(x_wire, k, tgt, w):
                 params = unpack_stage_params(row, metas[s])
                 out, _, _, aux = stage_fn(s)(params, x_wire, k, tgt, w)
-                return (_pvary_to(out, vary_axes), _pvary_to(aux, vary_axes))
+                return (_to_wire_type(out), _pvary_to(aux, vary_axes))
             return branch
 
         def make_bwd_branch(s):
@@ -204,9 +234,17 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                     vma = tuple(getattr(jax.typeof(primal), "vma", ()))
                     return _pvary_to(ct, vma)
                 cot_out = (like(jnp.zeros(cot_wire.shape, cot_wire.dtype),
-                                primals[0]) if is_last else cot_wire)
+                                primals[0]) if is_last
+                           else like(cot_wire, primals[0]))
                 d_params, d_x = pull((cot_out,
                                       like(jnp.float32(1.0), primals[1])))
+                if tp_on and not model_sharded[s]:
+                    # x_wire is typed model-invariant, so the pullback
+                    # psum'd n_model IDENTICAL full input-cotangents (the
+                    # replicas); rescale to the true value. TP stages need
+                    # no correction: their pullback's psum assembles the
+                    # per-shard PARTIALS, which is the real cotangent.
+                    d_x = d_x / n_model
                 # vma-aware autodiff semantics: ``params`` is data-INVARIANT
                 # (the buffer is replicated over the data axis), so the
                 # pullback's d_params must be too — jax inserts the implicit
@@ -217,7 +255,7 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                 # double-count.
                 grad_row = pack_stage_grads(d_params, metas[s], width)
                 return (_pvary_to(grad_row, vary_axes_nodata),
-                        _pvary_to(d_x, vary_axes),
+                        _pvary_to(d_x, wire_axes),
                         _pvary_to(num_raw, vary_axes))
             return branch
 
@@ -286,7 +324,8 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                  jnp.float32(0.0), jnp.float32(0.0))
         init = tuple(
             _pvary_to(jnp.zeros((width,), jnp.float32), vary_axes_nodata)
-            if a is None else _pvary_to(a, vary_axes) for a in init0)
+            if a is None else _pvary_to(a, wire_axes if i < 3 else vary_axes)
+            for i, a in enumerate(init0))
         carry, _ = lax.scan(step, init, jnp.arange(T))
         _, _, _, grad_acc, num_acc, aux_acc = carry
 

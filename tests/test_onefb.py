@@ -117,19 +117,15 @@ def test_1f1b_moe_aux_stage_matches():
                                rtol=5e-4, atol=2e-6)
 
 
-def test_1f1b_rejects_sharded_meshes():
+def test_1f1b_rejects_expert_meshes():
     from simple_distributed_machine_learning_tpu.parallel.onefb import (
         build_1f1b_fn,
     )
-    from simple_distributed_machine_learning_tpu.parallel.tensor import (
-        make_mlp_tp_stages,
-    )
 
-    stages, wire, out = make_mlp_tp_stages(jax.random.key(0),
-                                           [8, 16, 16, 16, 4], 2, 2)
-    mesh = make_mesh(n_stages=2, n_model=2)
+    stages, wire, out = make_mlp_stages(jax.random.key(0), [8, 16, 4], 2)
+    mesh = make_mesh(n_stages=2, n_expert=2)
     pipe = Pipeline(stages, mesh, wire, out, schedule="1f1b")
-    with pytest.raises(ValueError, match="stage\\+data meshes only"):
+    with pytest.raises(ValueError, match="expert-parallel"):
         build_1f1b_fn(pipe, True)
 
 
@@ -169,14 +165,14 @@ def test_cli_1f1b_end_to_end(capsys):
     assert "Test set: Average loss:" in out
 
 
-def test_cli_1f1b_rejects_tp():
+def test_cli_1f1b_rejects_ep():
     import pytest as _pytest
 
     from simple_distributed_machine_learning_tpu.cli import main
 
-    with _pytest.raises(SystemExit, match="stage\\+data meshes only"):
-        main(["--rank", "0", "--model", "mlp", "--schedule", "1f1b",
-              "--tp", "2"])
+    with _pytest.raises(SystemExit, match="no --ep"):
+        main(["--rank", "0", "--model", "gpt", "--schedule", "1f1b",
+              "--experts", "2", "--ep", "2"])
 
 
 def test_cli_1f1b_gpt(capsys):
@@ -275,3 +271,139 @@ print("SEQ_1F1B_OK", losses[-1])
         pytest.skip(f"XLA:CPU in-process rendezvous starvation ({attn})")
     assert last.returncode == 0, f"seq-1f1b {attn} failed:\n{last.stderr[-3000:]}"
     assert "SEQ_1F1B_OK" in last.stdout
+
+
+def test_1f1b_tensor_parallel_matches_gpipe():
+    """1F1B x tensor parallelism: Megatron column->row stages on a
+    dp x pp x tp mesh. The wire is typed model-invariant so the pullback's
+    implicit psum assembles per-shard partial cotangents; grads must be
+    BIT-EXACT vs the GPipe engine (same collectives, same order)."""
+    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+        make_mlp_tp_stages,
+    )
+
+    stages, wd, od = make_mlp_tp_stages(jax.random.key(0),
+                                        [8, 16, 16, 16, 4], 2, 2)
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 4)
+    for nd in (1, 2):
+        mesh = make_mesh(n_stages=2, n_model=2, n_data=nd)
+        gp = Pipeline(stages, mesh, wd, od, n_microbatches=2)
+        fb = Pipeline(stages, mesh, wd, od, n_microbatches=2,
+                      schedule="1f1b")
+        buf = gp.init_params()
+        k = jax.random.key(7)
+        lg, gg = gp.loss_and_grads(buf, x, y, k, deterministic=True)
+        lf, gf = fb.loss_and_grads(buf, x, y, k, deterministic=True)
+        np.testing.assert_allclose(float(lg), float(lf), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(gg), np.asarray(gf))
+
+
+def test_1f1b_replicated_stages_on_tp_mesh_match_fused():
+    """Plain (unsharded) stages on a model=2 mesh compute redundantly per
+    slot; the rescaled pullback must give every slot the FULL gradient
+    (slot grads identical and equal to the fused single-device grads).
+    The GPipe engine cannot run this case (its switch transpose trips a
+    vma mismatch) — the 1F1B engine covers it."""
+    from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        fused_reference,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.staging import (
+        unpack_stage_params,
+    )
+
+    stages, wd, od = make_mlp_stages(jax.random.key(0), [8, 16, 4], 2)
+    mesh = make_mesh(n_stages=2, n_model=2, n_data=1)
+    fb = Pipeline(stages, mesh, wd, od, n_microbatches=2, schedule="1f1b")
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 4)
+    buf = fb.init_params()
+    k = jax.random.key(7)
+    fused = fused_reference(stages)
+
+    def floss(b):
+        ps = [unpack_stage_params(b[s, 0, 0], fb.metas[s]) for s in range(2)]
+        return nll_loss(fused(ps, x, k, True), y, "mean")
+
+    lF, gF = jax.value_and_grad(floss)(buf)
+    lf, gf = fb.loss_and_grads(buf, x, y, k, deterministic=True)
+    np.testing.assert_allclose(float(lF), float(lf), rtol=1e-6)
+    gF, gf = np.asarray(gF), np.asarray(gf)
+    for s in range(2):
+        # every model slot holds the full gradient (the fused reference
+        # only populated slot 0)
+        for m in range(2):
+            np.testing.assert_allclose(gf[s, m, 0], gF[s, 0, 0],
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_1f1b_mixed_tp_and_plain_stages_grad_check():
+    """A TP pair stage feeding plain stages on one model=2 mesh: loss and
+    every gradient leaf match a hand-fused single-device reference
+    (GPipe's backward cannot run this stage mix — its switch transpose
+    trips a vma mismatch — so the fused model is the ground truth).
+
+    Replicated leaves INSIDE the sharded stage (the row bias, kept in sync
+    by grad_sync) get the FULL cotangent on every slot, so they are
+    compared against a reference that differentiates ONE shared copy."""
+    from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
+    from simple_distributed_machine_learning_tpu.parallel.staging import (
+        unpack_stage_params,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+        make_mlp_tp_stages,
+    )
+
+    tps, twd, _ = make_mlp_tp_stages(jax.random.key(0),
+                                     [8, 16, 16, 16, 4], 2, 2)
+    ps, pwd, pod = make_mlp_stages(jax.random.key(3), [16, 12, 4], 2)
+    mixed = [tps[0], ps[0], ps[1]]
+    mesh = make_mesh(n_stages=3, n_model=2, n_data=1)
+    gp = Pipeline(mixed, mesh, max(twd, pwd), pod, n_microbatches=2)
+    fb = Pipeline(mixed, mesh, max(twd, pwd), pod, n_microbatches=2,
+                  schedule="1f1b")
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 4)
+    buf = fb.init_params()
+    k = jax.random.key(7)
+    lg = gp.loss(buf, x, y, k, deterministic=True)   # fwd engines agree
+    lf, gf = fb.loss_and_grads(buf, x, y, k, deterministic=True)
+    np.testing.assert_allclose(float(lg), float(lf), rtol=1e-6)
+
+    def floss(b):
+        sh = [unpack_stage_params(b[0, m, 0], fb.metas[0]) for m in range(2)]
+        acc = 0
+        for m in range(2):
+            p = sh[m]
+            hm = jnp.maximum(x @ p["w1"]["w"] + p["w1"]["b"], 0)
+            acc = acc + hm @ p["w2"]["w"]
+        # ONE shared bias copy (slot 0): its gradient is the full cotangent
+        h = jnp.maximum(acc + sh[0]["w2"]["b"], 0)
+        for s in (1, 2):
+            p = unpack_stage_params(b[s, 0, 0], fb.metas[s])
+            h = fb.stages[s].apply(p, h.reshape(h.shape[0], -1), k, True)
+        return nll_loss(h, y, "mean")
+
+    lF, gF = jax.value_and_grad(floss)(buf)
+    np.testing.assert_allclose(float(lF), float(lf), rtol=1e-6)
+    gF, gfn = np.asarray(gF), np.asarray(gf)
+    meta0 = fb.metas[0]
+    ref0 = unpack_stage_params(jnp.asarray(gF[0, 0, 0]), meta0)
+    for m in range(2):
+        got = unpack_stage_params(jnp.asarray(gfn[0, m, 0]), meta0)
+        ref_m = unpack_stage_params(jnp.asarray(gF[0, m, 0]), meta0)
+        # sharded leaves: per-slot reference; the replicated bias: the
+        # shared-copy (slot 0) reference on every slot
+        np.testing.assert_allclose(got["w1"]["w"], ref_m["w1"]["w"],
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(got["w1"]["b"], ref_m["w1"]["b"],
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(got["w2"]["w"], ref_m["w2"]["w"],
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(got["w2"]["b"], ref0["w2"]["b"],
+                                   rtol=1e-5, atol=1e-7)
+    for s in (1, 2):
+        for m in range(2):
+            np.testing.assert_allclose(gfn[s, m, 0], gF[s, 0, 0],
+                                       rtol=1e-5, atol=1e-7)
