@@ -81,8 +81,11 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     """
     if pipe.n_expert > 1:
         raise ValueError(
-            "the 1F1B schedule does not support expert-parallel meshes yet "
-            f"(expert={pipe.n_expert}); use schedule='gpipe' for ep runs")
+            "the 1F1B schedule does not support expert-parallel meshes yet: "
+            "with ep the MoE aux-loss x-cotangent accounting diverges from "
+            "the GPipe engine (everything else — num path, expert weights, "
+            "grad-synced leaves — matches exactly at aux_weight=0); use "
+            "schedule='gpipe' for ep runs")
     if pipe.n_seq > 1 and len(pipe.out_shape) < 2:
         raise ValueError(
             "1F1B on a seq-parallel mesh needs a per-token output shape "
@@ -116,9 +119,12 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     # mixing via ring/Ulysses collectives, which jax.vjp transposes
     seq_on = pipe.n_seq > 1
     tp_on = pipe.n_model > 1
+    ep_on = pipe.n_expert > 1
     n_model = pipe.n_model
-    # which stages carry REAL tensor shards (vs redundant replicas)
+    n_expert = pipe.n_expert
+    # which stages carry REAL tensor / expert shards (vs redundant replicas)
     model_sharded = [s.shards is not None for s in pipe.stages]
+    expert_sharded = [s.expert_shards is not None for s in pipe.stages]
     # the mesh always carries all five named axes (size 1 when unused); the
     # param row varies over stage/model/expert via its sharding, inputs over
     # data (and seq when the token axis is sharded) — match the GPipe
@@ -137,8 +143,17 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     # assemble the true input cotangent for TP stages (sum of per-shard
     # partials); replicated stages' pullbacks then overcount by n_model
     # (n identical full cotangents summed) and are rescaled below.
-    wire_axes = (tuple(a for a in vary_axes if a != MODEL_AXIS)
-                 if tp_on else vary_axes)
+    #
+    # expert parallelism uses the OPPOSITE discipline — GPipe's: wires stay
+    # expert-VARYING (each slot carries its own chain's cotangent), every
+    # objective seed is divided by n_expert, and the expert-axis psums
+    # living inside the applies' custom vjps (all-to-all transposes,
+    # expert.py's grad_sync of replicated leaves) reassemble full
+    # gradients from the n 1/n-weighted chains. Expert-replicated stages'
+    # params get the same grad_sync wrap the GPipe branches give them.
+    shard_axes = (MODEL_AXIS,) if tp_on else ()
+    wire_axes = tuple(a for a in vary_axes if a not in shard_axes)
+    ep_div = n_expert if ep_on else 1
 
     def per_device(row4d, x_mb, tgt_mb, w_mb, key):
         row = row4d[0, 0, 0]
@@ -174,6 +189,16 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
             def fn(params, x_wire, k, tgt, w):
                 x = wire_decode(x_wire, in_shapes[s])
                 p = params
+                if ep_on and not expert_sharded[s]:
+                    # GPipe's replicated-params treatment on the expert
+                    # axis: grad_sync's backward psums the n per-slot
+                    # (1/n-seeded) cotangents into the full gradient on
+                    # every slot, keeping the replicas in sync
+                    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+                        grad_sync,
+                    )
+                    p = jax.tree.map(
+                        lambda a: grad_sync(a, EXPERT_AXIS), p)
                 if compute_dtype is not None:
                     p = jax.tree.map(lambda a: a.astype(compute_dtype), p)
                     x = x.astype(compute_dtype)
@@ -182,14 +207,15 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                 if isinstance(y, tuple):
                     y, aux = y
                     aux = aux.astype(jnp.float32)
-                obj = aux / (M * n_data * (pipe.n_seq if seq_on else 1))
+                obj = aux / (M * n_data * (pipe.n_seq if seq_on else 1)
+                             * ep_div)
                 num_raw = jnp.float32(0.0)
                 if is_last:
                     nll = nll_loss(y.astype(jnp.float32), tgt, "none")
                     wb = jnp.broadcast_to(
                         w.reshape(w.shape + (1,) * (nll.ndim - 1)), nll.shape)
                     num_raw = jnp.sum(nll * wb)
-                    obj = obj + num_raw / den_g
+                    obj = obj + num_raw / (den_g * ep_div)
                     out = jnp.zeros((x_wire.shape[0], wire_dim), jnp.float32)
                 else:
                     out = wire_encode(y.astype(jnp.float32), wire_dim)
@@ -198,14 +224,13 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
 
         def _to_wire_type(v):
             """Normalize an activation to the wire's vma: a replicated
-            stage's output is typed model-varying (its param row is) with
-            REPLICATED values — pmean over 'model' is the identity-valued
-            replication proof that drops the axis (the GPipe engine's
+            stage's output is typed model/expert-varying (its param row is)
+            with REPLICATED values — pmean over the axis is the identity-
+            valued replication proof that drops it (the GPipe engine's
             logits/num trick); then pvary any missing axes."""
-            if tp_on:
-                have = getattr(jax.typeof(v), "vma", frozenset())
-                if MODEL_AXIS in have:
-                    v = lax.pmean(v, MODEL_AXIS)
+            for ax in shard_axes:
+                if ax in getattr(jax.typeof(v), "vma", frozenset()):
+                    v = lax.pmean(v, ax)
             return _pvary_to(v, wire_axes)
 
         def make_fwd_branch(s):
@@ -238,12 +263,12 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                            else like(cot_wire, primals[0]))
                 d_params, d_x = pull((cot_out,
                                       like(jnp.float32(1.0), primals[1])))
+                # x_wire is typed invariant over each sharded axis, so
+                # the pullback psum'd the per-slot input-cotangents over
+                # it: for sharded stages that assembles the PARTIALS (the
+                # real cotangent, no correction); for replicated stages it
+                # summed n IDENTICAL full cotangents — rescale per axis.
                 if tp_on and not model_sharded[s]:
-                    # x_wire is typed model-invariant, so the pullback
-                    # psum'd n_model IDENTICAL full input-cotangents (the
-                    # replicas); rescale to the true value. TP stages need
-                    # no correction: their pullback's psum assembles the
-                    # per-shard PARTIALS, which is the real cotangent.
                     d_x = d_x / n_model
                 # vma-aware autodiff semantics: ``params`` is data-INVARIANT
                 # (the buffer is replicated over the data axis), so the
