@@ -173,6 +173,18 @@ def _configs():
     }
 
 
+def _xl_config():
+    """MXU-stretch bf16 GPT (d=1024, T=512): not part of ``--all`` (slower
+    compile + more HBM than the sweep budget wants); run explicitly with
+    ``python bench.py --config gpt_bf16_xl`` to probe peak MFU."""
+    from simple_distributed_machine_learning_tpu.models.gpt import GPTConfig
+
+    xl = GPTConfig(vocab=8192, seq_len=512, d_model=1024, n_heads=16,
+                   n_layers=4)
+    return dict(kind="gpt", cfg=xl, batch=8, n_micro=1, steps=24,
+                flops=_gpt_flops(xl), dtype="bfloat16")
+
+
 def _smoke_check(timeout_s: float = 90.0) -> None:
     """Fail fast with a diagnosis if the accelerator is unresponsive.
 
@@ -351,7 +363,8 @@ def main() -> None:
     ap.add_argument("--all", action="store_true",
                     help="measure every config, one JSON line each, and "
                          "write benchmarks/results_all.json")
-    ap.add_argument("--config", default="mlp2", choices=list(_configs()),
+    ap.add_argument("--config", default="mlp2",
+                    choices=list(_configs()) + ["gpt_bf16_xl"],
                     help="single config to measure (default: headline mlp2)")
     ap.add_argument("--steps", type=int, default=None,
                     help="override the per-config scan-window length (use "
@@ -377,6 +390,8 @@ def main() -> None:
         baselines.get("jax_cpu_pipeline_samples_per_sec")
 
     configs = _configs()
+    if args.config == "gpt_bf16_xl":
+        configs["gpt_bf16_xl"] = _xl_config()
     names = list(configs) if args.all else [args.config]
     _smoke_check()
     rows = []

@@ -24,6 +24,19 @@ Both hops ride ``lax.ppermute`` rings in opposite directions inside one
 come out packed in the param buffer's ``[S, 1, 1, P]`` layout, ready for
 the owner-local optimizer update (no autodiff through the scan at all).
 
+Worked timeline, S=2 stages, M=3 microbatches (T = M + 2S - 1 = 6 ticks;
+``Fm`` = forward of microbatch m, ``Bm`` = backward; stage0: m_f = t,
+m_b = t - 3; stage1: m_f = t - 1, m_b = t - 2):
+
+    tick     0     1     2        3        4     5
+    stage0   F0    F1    F2       B0       B1    B2
+    stage1   .     F0    F1+B0    F2+B1    B2    .
+
+stage1 runs a forward and a backward in the same tick (the steady-state
+interleave; middle stages of deeper pipelines do the same); stage0's
+backward lags one extra tick because the cotangent crosses the reverse
+ring. Each saved input lives at most 2S-1 ticks.
+
 Scope: stage x data x seq x model meshes. Sequence parallelism composes
 (ring / Ulysses collectives inside stage applies transpose under the vjp;
 the pullback's implicit psum extends to the seq axis since params are
