@@ -483,9 +483,25 @@ class Pipeline:
                     # replicated stage's. Value-identity; the transpose
                     # (psum of per-replica cotangents, each ct/n after the
                     # loss pmean) reassembles the full cotangent.
-                    return (_pvary_to(out, vary_axes),
-                            _pvary_to(aux, vary_axes),
-                            _pvary_to(y_out, vary_axes))
+                    #
+                    # the zero-valued full-vma anchor additionally pins each
+                    # branch's INPUT-cotangent type: without it, branches
+                    # whose wire feeds a narrower-vma path (e.g. a plain
+                    # stage beside sharded ones, or the last stage's
+                    # loss-only use) transpose to mismatched cotangent vmas
+                    # and jax's cond transpose rejects the switch
+                    # ("mismatched varying manual axes"). Adding 0*sum(wire)
+                    # is value-free but makes every branch's wire cotangent
+                    # at least vary_axes-typed.
+                    # wire AND the closed-over param row (closure captures
+                    # are hoisted into cond operands and need the same
+                    # treatment)
+                    anchor = _pvary_to(
+                        jnp.float32(0.0) * (jnp.sum(wire) + jnp.sum(row)),
+                        vary_axes)
+                    return (_pvary_to(out, vary_axes) + anchor,
+                            _pvary_to(aux, vary_axes) + anchor,
+                            _pvary_to(y_out, vary_axes) + anchor)
                 if remat:
                     return jax.checkpoint(branch)
                 return branch

@@ -303,8 +303,8 @@ def test_1f1b_replicated_stages_on_tp_mesh_match_fused():
     """Plain (unsharded) stages on a model=2 mesh compute redundantly per
     slot; the rescaled pullback must give every slot the FULL gradient
     (slot grads identical and equal to the fused single-device grads).
-    The GPipe engine cannot run this case (its switch transpose trips a
-    vma mismatch) — the 1F1B engine covers it."""
+    (Historically the GPipe engine's switch transpose rejected this case;
+    its branch anchor now covers it too — tests/test_pipeline.py.)"""
     from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
     from simple_distributed_machine_learning_tpu.parallel.pipeline import (
         fused_reference,
