@@ -61,7 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "autodiff backward (activation memory grows with "
                         "microbatches); 1f1b = interleaved one-forward-one-"
                         "backward with recompute (memory bounded by the "
-                        "stage count; composes with --dp/--tp/--sp)")
+                        "stage count; composes with --dp/--tp/--sp/--ep)")
     g.add_argument('--dp', type=int, default=1,
                    help="data-parallel mesh width (batch must divide by "
                         "dp * microbatches)")
@@ -229,9 +229,6 @@ def _dispatch(args) -> None:
         raise SystemExit("--sp is only supported with --model=gpt")
     if args.ep > 1 and (args.model != "gpt" or args.experts < 1):
         raise SystemExit("--ep needs --model=gpt with --experts > 0")
-    if args.schedule == "1f1b" and args.ep > 1:
-        raise SystemExit("--schedule 1f1b composes with --dp/--tp/--sp "
-                         "(no --ep yet)")
     if args.model == "gpt":
         _run_gpt(args, n_stages, key)
         return

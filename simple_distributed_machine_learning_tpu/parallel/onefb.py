@@ -37,19 +37,25 @@ interleave; middle stages of deeper pipelines do the same); stage0's
 backward lags one extra tick because the cotangent crosses the reverse
 ring. Each saved input lives at most 2S-1 ticks.
 
-Scope: stage x data x seq x model meshes. Sequence parallelism composes
-(ring / Ulysses collectives inside stage applies transpose under the vjp;
-the pullback's implicit psum extends to the seq axis since params are
-seq-invariant). Tensor parallelism composes too: wires are typed model-
-INVARIANT, so a TP stage's pullback assembles its per-shard partial input
-cotangents via the same implicit psum, while replicated stages' pullbacks
-are rescaled by 1/n_model (they would otherwise sum n identical full
-cotangents); every model slot ends up holding the full gradient for its
-row, matching the GPipe engine bit-exactly on full-TP pipelines. Expert
-(MoE-sharded) meshes still route to the GPipe engine. Dense stages
-including aux-loss (dense-MoE) stages. The reference
-has no analogue of any of this — its two-stage "schedule" is one blocking
-RPC per batch with zero overlap (``simple_distributed.py:49``, SURVEY §3.3).
+Scope: ALL five mesh axes compose — stage x data x seq x model x expert.
+Sequence parallelism: ring / Ulysses collectives inside stage applies
+transpose under the vjp; the pullback's implicit psum extends to the seq
+axis since params are seq-invariant. Tensor parallelism: wires are typed
+model-INVARIANT, so a TP stage's pullback assembles its per-shard partial
+input cotangents via the same implicit psum, while replicated stages'
+pullbacks are rescaled by 1/n_model (they would otherwise sum n identical
+full cotangents) — bit-exact vs the GPipe engine on full-TP pipelines.
+Expert parallelism uses the opposite, GPipe-native discipline: wires stay
+expert-VARYING (each slot carries its own chain's cotangent), objective
+seeds divide by n_expert, expert-replicated stages' params get grad_sync
+wraps, and — crucially — each stage's aux loss is pcast to expert-varying
+INSIDE the differentiated function before entering the objective, so the
+pcast transpose reassembles the full aux cotangent from the n 1/n seeds
+(without it, a non-last MoE stage's expert-invariant aux node starves by
+1/n_expert; the last stage was saved only by its varying num term forcing
+the same pcast). The reference has no analogue of any of this — its
+two-stage "schedule" is one blocking RPC per batch with zero overlap
+(``simple_distributed.py:49``, SURVEY §3.3).
 
 CPU-backend caveat (virtual-device testing only): with seq parallelism the
 per-tick collective density is high enough that XLA:CPU's in-process
@@ -92,13 +98,6 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     ``grads`` shaped/sharded like the packed param buffer. Inputs are the
     ``Pipeline._prep_inputs`` layout.
     """
-    if pipe.n_expert > 1:
-        raise ValueError(
-            "the 1F1B schedule does not support expert-parallel meshes yet: "
-            "with ep the MoE aux-loss x-cotangent accounting diverges from "
-            "the GPipe engine (everything else — num path, expert weights, "
-            "grad-synced leaves — matches exactly at aux_weight=0); use "
-            "schedule='gpipe' for ep runs")
     if pipe.n_seq > 1 and len(pipe.out_shape) < 2:
         raise ValueError(
             "1F1B on a seq-parallel mesh needs a per-token output shape "
@@ -220,8 +219,21 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                 if isinstance(y, tuple):
                     y, aux = y
                     aux = aux.astype(jnp.float32)
-                obj = aux / (M * n_data * (pipe.n_seq if seq_on else 1)
-                             * ep_div)
+                # pvary aux over the EXPERT axis before it enters the
+                # objective (GPipe's branch-exit pcast, done inside the
+                # differentiated function): an EP-MoE stage's aux is
+                # expert-INVARIANT (expert.py pmeans it), and without this
+                # the aux node of a NON-last stage received a
+                # 1/n_expert-starved cotangent — the last stage was saved
+                # only by its varying num term forcing the same implicit
+                # pcast. The pcast's transpose psums the n per-slot 1/n
+                # seeds into the full cotangent. EXPERT ONLY: the model
+                # axis runs the invariant-wire discipline, where an extra
+                # pcast would double-count through its psum transpose.
+                if ep_on:
+                    aux = _pvary_to(aux, (EXPERT_AXIS,))
+                obj = aux / (
+                    M * n_data * (pipe.n_seq if seq_on else 1) * ep_div)
                 num_raw = jnp.float32(0.0)
                 if is_last:
                     nll = nll_loss(y.astype(jnp.float32), tgt, "none")
