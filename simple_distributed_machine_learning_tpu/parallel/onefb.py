@@ -192,9 +192,11 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
             """The pure per-microbatch stage function the backward vjp's:
             params, x -> (wire_out, objective_contribution, num_raw, aux).
 
-            Last stage: objective = sum(w*nll)/den_g + aux/(M*n_data) (its
-            wire_out is zeros). Inner stage: objective = aux/(M*n_data)
-            (NLL reaches it only through the wire cotangent).
+            Last stage: objective = sum(w*nll)/(den_g*ep_div) +
+            aux/(M*n_data*n_seq*ep_div) (its wire_out is zeros). Inner
+            stage: the aux term only (NLL reaches it through the wire
+            cotangent). Every divisor mirrors the GPipe engine's psum/pmean
+            reduction of the same term.
             """
             is_last = s == S - 1
 
