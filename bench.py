@@ -390,7 +390,9 @@ def main() -> None:
         baselines.get("jax_cpu_pipeline_samples_per_sec")
 
     configs = _configs()
-    if args.config == "gpt_bf16_xl":
+    if args.config == "gpt_bf16_xl" and not args.all:
+        # explicit opt-in only: never joins the --all sweep (slow compile,
+        # heavy HBM; _xl_config's contract)
         configs["gpt_bf16_xl"] = _xl_config()
     names = list(configs) if args.all else [args.config]
     _smoke_check()

@@ -21,19 +21,17 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "onefb_memory.json")
 
 
-def main() -> None:
+def temp_bytes(schedule: str, m: int) -> int:
+    """Temp allocation of the compiled loss+grads program for one schedule
+    at ``m`` microbatches. The SAME helper backs both this benchmark and
+    tests/test_onefb.py's memory-scaling assertion, so the recorded
+    artifact and the CI guarantee can never measure different programs.
+    Requires an initialized jax (any backend; the test and main() both use
+    the 8-virtual-device CPU mesh)."""
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
     import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
-    except RuntimeError:
-        pass
 
     from simple_distributed_machine_learning_tpu.models.mlp import (
         make_mlp_stages,
@@ -43,19 +41,28 @@ def main() -> None:
         Pipeline,
     )
 
-    def temp_bytes(schedule: str, m: int) -> int:
-        stages, wire, out = make_mlp_stages(jax.random.key(0),
-                                            [256, 256, 10], 2)
-        mesh = make_mesh(n_stages=2, n_data=1)
-        p = Pipeline(stages, mesh, wire, out, n_microbatches=m,
-                     schedule=schedule)
-        x = jax.random.normal(jax.random.key(1), (16 * m, 256))
-        y = jax.random.randint(jax.random.key(2), (16 * m,), 0, 10)
-        buf = p.init_params()
-        f = jax.jit(lambda b: p.loss_and_grads(b, x, y, jax.random.key(3),
-                                               deterministic=True))
-        return int(f.lower(buf).compile().memory_analysis()
-                   .temp_size_in_bytes)
+    stages, wire, out = make_mlp_stages(jax.random.key(0), [256, 256, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    p = Pipeline(stages, mesh, wire, out, n_microbatches=m,
+                 schedule=schedule)
+    x = jax.random.normal(jax.random.key(1), (16 * m, 256))
+    y = jax.random.randint(jax.random.key(2), (16 * m,), 0, 10)
+    buf = p.init_params()
+    f = jax.jit(lambda b: p.loss_and_grads(b, x, y, jax.random.key(3),
+                                           deterministic=True))
+    return int(f.lower(buf).compile().memory_analysis().temp_size_in_bytes)
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except RuntimeError:
+        pass
 
     rows = []
     for m in (1, 4, 16, 64):

@@ -166,6 +166,7 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     shard_axes = (MODEL_AXIS,) if tp_on else ()
     wire_axes = tuple(a for a in vary_axes if a not in shard_axes)
     ep_div = n_expert if ep_on else 1
+    cpu_backend = jax.default_backend() == "cpu"
 
     def per_device(row4d, x_mb, tgt_mb, w_mb, key):
         row = row4d[0, 0, 0]
@@ -360,12 +361,14 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
 
             # ---- the two rings -----------------------------------------
             wire_f = lax.ppermute(out_f, STAGE_AXIS, fwd_ring)
-            # serialize the reverse hop behind the forward one: the two are
-            # data-independent, and letting the runtime float both (plus the
-            # branch collectives) concurrently starves XLA:CPU's in-process
-            # rendezvous on few-core machines; a single token dependency
-            # bounds in-flight collectives at no cost to compute overlap
-            wire_f, d_x = lax.optimization_barrier((wire_f, d_x))
+            if cpu_backend:
+                # serialize the reverse hop behind the forward one ON THE
+                # CPU BACKEND ONLY: the hops are data-independent, and
+                # letting the runtime float both (plus branch collectives)
+                # concurrently starves XLA:CPU's in-process rendezvous on
+                # few-core machines. On TPU the barrier would cost one ICI
+                # hop of comm-comm overlap per tick, so it is omitted.
+                wire_f, d_x = lax.optimization_barrier((wire_f, d_x))
             wire_b = lax.ppermute(d_x, STAGE_AXIS, bwd_ring)
             return (wire_f, wire_b, inbuf, grad_acc, num_acc, aux_acc), None
 

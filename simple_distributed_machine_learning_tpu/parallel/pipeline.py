@@ -492,10 +492,10 @@ class Pipeline:
                     # and jax's cond transpose rejects the switch
                     # ("mismatched varying manual axes"). Adding 0*sum(wire)
                     # is value-free but makes every branch's wire cotangent
-                    # at least vary_axes-typed.
-                    # wire AND the closed-over param row (closure captures
-                    # are hoisted into cond operands and need the same
-                    # treatment)
+                    # at least vary_axes-typed. The anchor sums BOTH the
+                    # wire and the closed-over param row: closure captures
+                    # are hoisted into cond operands, so the row's cotangent
+                    # type needs the same pinning.
                     anchor = _pvary_to(
                         jnp.float32(0.0) * (jnp.sum(wire) + jnp.sum(row)),
                         vary_axes)

@@ -153,20 +153,17 @@ def test_1f1b_memory_flat_in_microbatches():
     """The schedule's reason to exist: compiled temp memory is bounded by
     the topology S, not the microbatch count M (GPipe's grows with M
     because autodiff keeps every microbatch's residuals alive between the
-    sweeps). Measured from XLA's own memory analysis."""
+    sweeps). Measured from XLA's own memory analysis, via the SAME helper
+    benchmarks/onefb_memory.py records its artifact with."""
+    import importlib.util
+    import os
 
-    def temp_bytes(schedule, M):
-        stages, wire, out = make_mlp_stages(jax.random.key(0),
-                                            [256, 256, 10], 2)
-        mesh = make_mesh(n_stages=2, n_data=1)
-        p = Pipeline(stages, mesh, wire, out, n_microbatches=M,
-                     schedule=schedule)
-        x = jax.random.normal(jax.random.key(1), (16 * M, 256))
-        y = jax.random.randint(jax.random.key(2), (16 * M,), 0, 10)
-        buf = p.init_params()
-        f = jax.jit(lambda b: p.loss_and_grads(b, x, y, jax.random.key(3),
-                                               deterministic=True))
-        return f.lower(buf).compile().memory_analysis().temp_size_in_bytes
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "onefb_memory", os.path.join(repo, "benchmarks", "onefb_memory.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    temp_bytes = mod.temp_bytes
 
     g4, g32 = temp_bytes("gpipe", 4), temp_bytes("gpipe", 32)
     f4, f32 = temp_bytes("1f1b", 4), temp_bytes("1f1b", 32)
