@@ -230,7 +230,8 @@ def _smoke_check(timeout_s: float = 90.0) -> None:
     os._exit(17)
 
 
-def measure(name: str, spec: dict, windows: int = 5) -> dict:
+def measure(name: str, spec: dict, windows: int = 5,
+            schedule: str = "gpipe") -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -270,8 +271,11 @@ def measure(name: str, spec: dict, windows: int = 5) -> dict:
 
     mesh = make_mesh(n_stages=n_stages, n_data=1)
     dtype = jnp.bfloat16 if spec["dtype"] == "bfloat16" else None
+    # 1f1b needs >= 2 stages; on a single chip the pipeline degenerates to
+    # the fused path either way
+    sched = schedule if n_stages >= 2 else "gpipe"
     pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=n_micro,
-                    compute_dtype=dtype)
+                    compute_dtype=dtype, schedule=sched)
     buf = pipe.init_params()
     opt = sgd(0.1, momentum=0.5)
     opt_state = opt.init(buf)
@@ -355,7 +359,29 @@ def _measure_torch_rpc_baseline() -> float:
     raise RuntimeError(f"torch rpc baseline failed: {out.stderr[-2000:]}")
 
 
+def _apply_env_platform() -> None:
+    """Honor JAX_PLATFORMS / xla_force_host_platform_device_count even when
+    sitecustomize already imported jax and latched the TPU plugin (same shim
+    as cli.py) — lets the bench run on virtual CPU devices for schedule
+    smoke-tests. No-op in the driver's TPU invocation (no env override)."""
+    import re
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", plat)
+        m = re.search(r"xla_force_host_platform_device_count=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        if m and plat == "cpu":
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+    except RuntimeError:
+        pass
+
+
 def main() -> None:
+    _apply_env_platform()
     ap = argparse.ArgumentParser()
     ap.add_argument("--measure-baseline", action="store_true",
                     help="re-measure CPU baselines and rewrite "
@@ -369,6 +395,10 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=None,
                     help="override the per-config scan-window length (use "
                          "when dispatch noise exceeds the window)")
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b"),
+                    default="gpipe",
+                    help="pipeline schedule to bench (1f1b engages only "
+                         "with >= 2 pipeline stages, i.e. >= 2 chips)")
     args = ap.parse_args()
 
     if args.measure_baseline or not os.path.exists(BASELINE_PATH):
@@ -400,7 +430,7 @@ def main() -> None:
     for name in names:
         spec = (dict(configs[name], steps_override=args.steps)
                 if args.steps else configs[name])
-        res = measure(name, spec)
+        res = measure(name, spec, schedule=args.schedule)
         # vs_baseline only for the headline: the torch-RPC baseline runs the
         # 2-stage MLP workload, not the others
         vs = (round(res["samples_per_sec"] / base, 2)
