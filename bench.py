@@ -163,13 +163,16 @@ def _configs():
         "gpt": dict(kind="gpt", cfg=tiny_gpt, batch=32, n_micro=4,
                     steps=1000, flops=_gpt_flops(tiny_gpt), dtype=None),
         # MXU-sized bf16 GPT: the MFU row (not a BASELINE config; sized so
-        # the matmuls are large enough for the systolic array to matter)
+        # the matmuls are large enough for the systolic array to matter).
+        # bf16 rows train with AdamW: SGD at the f32 rows' lr=0.1 diverges
+        # to NaN in half precision (observed r4), and a NaN final_loss means
+        # the throughput was measured on garbage values
         "gpt_bf16": dict(kind="gpt", cfg=big_gpt, batch=16, n_micro=1,
                          steps=100, flops=_gpt_flops(big_gpt),
-                         dtype="bfloat16"),
+                         dtype="bfloat16", opt="adamw"),
         "mlp2_bf16": dict(kind="mlp", dims=mlp2, batch=60, n_micro=1,
                           steps=15000, flops=_mlp_flops(mlp2),
-                          dtype="bfloat16"),
+                          dtype="bfloat16", opt="adamw"),
     }
 
 
@@ -182,7 +185,7 @@ def _xl_config():
     xl = GPTConfig(vocab=8192, seq_len=512, d_model=1024, n_heads=16,
                    n_layers=4)
     return dict(kind="gpt", cfg=xl, batch=8, n_micro=1, steps=24,
-                flops=_gpt_flops(xl), dtype="bfloat16")
+                flops=_gpt_flops(xl), dtype="bfloat16", opt="adamw")
 
 
 def _smoke_check(timeout_s: float = 90.0) -> None:
@@ -239,7 +242,10 @@ def measure(name: str, spec: dict, windows: int = 5,
     from simple_distributed_machine_learning_tpu.parallel.pipeline import (
         Pipeline,
     )
-    from simple_distributed_machine_learning_tpu.train.optimizer import sgd
+    from simple_distributed_machine_learning_tpu.train.optimizer import (
+        adamw,
+        sgd,
+    )
     from simple_distributed_machine_learning_tpu.train.step import (
         make_scanned_train_step,
     )
@@ -277,7 +283,8 @@ def measure(name: str, spec: dict, windows: int = 5,
     pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=n_micro,
                     compute_dtype=dtype, schedule=sched)
     buf = pipe.init_params()
-    opt = sgd(0.1, momentum=0.5)
+    opt = (adamw(1e-3) if spec.get("opt") == "adamw"
+           else sgd(0.1, momentum=0.5))
     opt_state = opt.init(buf)
     step = make_scanned_train_step(pipe, opt, pool_steps=steps)
     key = jax.random.key(0)
@@ -325,8 +332,64 @@ def measure(name: str, spec: dict, windows: int = 5,
         "mfu": round(achieved / (n_stages * peak), 4) if peak else None,
         "device_kind": kind,
         "backend": jax.default_backend(),
+        "optimizer": spec.get("opt", "sgd"),
+        "schedule": sched,
         "final_loss": round(final_loss, 4),
     }
+
+
+def measure_decode(windows: int = 5) -> dict:
+    """Decode throughput: KV-cache vs full-prefix-recompute decoders.
+
+    The MXU-sized GPT (d=512, L=4, V=8192) generating 224 tokens from a
+    32-token prompt, batch 8. The recompute decoder re-forwards the whole
+    T=256 buffer every step (O(T²) per sequence, models/gpt.py:make_decoder);
+    the cached decoder pushes one token against per-layer K/V buffers
+    (make_cached_decoder). Both are one compiled ``lax.scan`` dispatch, so
+    two-point timing is unnecessary — the scan body dominates.
+    """
+    import jax
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_cached_decoder,
+        make_decoder,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=8192, seq_len=256, d_model=512, n_heads=8,
+                    n_layers=4)
+    t0, n_new, b = 32, 224, 8
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
+    params = [s.params for s in stages]
+    prompt = jax.random.randint(jax.random.key(1), (b, t0), 0, cfg.vocab)
+    key = jax.random.key(2)
+
+    def timed(fn):
+        jax.block_until_ready(fn(params, prompt, key))      # compile + warm
+        ts = []
+        for _ in range(windows):
+            t_start = time.perf_counter()
+            jax.block_until_ready(fn(params, prompt, key))
+            ts.append(time.perf_counter() - t_start)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    cached_s = timed(make_cached_decoder(stages, cfg, t0, n_new))
+    recompute_s = timed(make_decoder(stages, t0, n_new))
+    row = {
+        "config": "gpt_decode",
+        "prompt_len": t0, "n_new": n_new, "batch": b,
+        "tokens_per_sec_cached": round(b * n_new / cached_s, 1),
+        "tokens_per_sec_recompute": round(b * n_new / recompute_s, 1),
+        "speedup": round(recompute_s / cached_s, 2),
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }
+    with open(os.path.join(REPO, "benchmarks", "decode_timing.json"),
+              "w") as f:
+        json.dump(row, f, indent=2)
+    return row
 
 
 def _measure_jax_cpu_baseline() -> float:
@@ -389,9 +452,10 @@ def main() -> None:
     ap.add_argument("--all", action="store_true",
                     help="measure every config, one JSON line each, and "
                          "write benchmarks/results_all.json")
-    ap.add_argument("--config", default="mlp2",
+    ap.add_argument("--config", default=None,
                     choices=list(_configs()) + ["gpt_bf16_xl"],
-                    help="single config to measure (default: headline mlp2)")
+                    help="single config to measure (default: headline mlp2; "
+                         "with --decode and no --config, decode only)")
     ap.add_argument("--steps", type=int, default=None,
                     help="override the per-config scan-window length (use "
                          "when dispatch noise exceeds the window)")
@@ -399,6 +463,9 @@ def main() -> None:
                     default="gpipe",
                     help="pipeline schedule to bench (1f1b engages only "
                          "with >= 2 pipeline stages, i.e. >= 2 chips)")
+    ap.add_argument("--decode", action="store_true",
+                    help="measure KV-cache vs recompute decode tokens/sec "
+                         "(also runs as part of --all)")
     args = ap.parse_args()
 
     if args.measure_baseline or not os.path.exists(BASELINE_PATH):
@@ -424,8 +491,23 @@ def main() -> None:
         # explicit opt-in only: never joins the --all sweep (slow compile,
         # heavy HBM; _xl_config's contract)
         configs["gpt_bf16_xl"] = _xl_config()
-    names = list(configs) if args.all else [args.config]
+    # --decode is additive: an explicit --config still runs; only a bare
+    # --decode (no --all, no --config) measures decode alone
+    if args.all:
+        names = list(configs)
+    elif args.config is not None:
+        names = [args.config]
+    else:
+        names = [] if args.decode else ["mlp2"]
     _smoke_check()
+    if args.decode or args.all:
+        drow = measure_decode()
+        print(json.dumps({
+            "metric": "gpt_decode_tokens_per_sec",
+            "value": drow["tokens_per_sec_cached"],
+            "unit": "tokens/sec",
+            "vs_recompute": drow["speedup"],
+        }))
     rows = []
     for name in names:
         spec = (dict(configs[name], steps_override=args.steps)
@@ -447,11 +529,29 @@ def main() -> None:
             "achieved_tflops": res["achieved_tflops"],
             "dtype": res["dtype"],
             "n_chips": res["n_chips"],
+            "schedule": res["schedule"],
+            "optimizer": res["optimizer"],
         }))
     if args.all:
-        with open(RESULTS_PATH, "w") as f:
+        # results_all.json is the authoritative GPipe artifact — a 1f1b sweep
+        # writes its own file instead of silently overwriting it with rows
+        # that used to be indistinguishable. Both the filename and the
+        # top-level field reflect what actually RAN, not what was requested:
+        # on one chip a --schedule 1f1b sweep degenerates to gpipe rows
+        # (measure()'s n_stages < 2 fallback) and is recorded as such
+        ran = {r["schedule"] for r in rows}
+        sched_actual = ran.pop() if len(ran) == 1 else "mixed"
+        if sched_actual != args.schedule:
+            sys.stderr.write(
+                f"bench: requested --schedule {args.schedule} but rows ran "
+                f"{sched_actual} (single-chip fallback?); recording "
+                f"{sched_actual}\n")
+        path = (RESULTS_PATH if sched_actual == "gpipe" else
+                RESULTS_PATH.replace(".json", f"_{sched_actual}.json"))
+        with open(path, "w") as f:
             json.dump({"device": rows[0]["device_kind"],
                        "backend": rows[0]["backend"],
+                       "schedule": sched_actual,
                        "rows": rows}, f, indent=2)
 
 

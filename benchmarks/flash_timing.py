@@ -102,11 +102,15 @@ def main() -> None:
                 raise
             dense_ms = None
         flash_ms = _time(flash, q, k, v)
+        # dense_ms stays numeric-or-null (a string "OOM" broke consumers);
+        # dense_oom carries the OOM fact separately
         row = {"t": t, "dtype": dtype, "b": B, "h": H, "dh": dh,
-               "dense_ms": round(dense_ms, 3) if dense_ms else "OOM",
+               "dense_ms": (round(dense_ms, 3) if dense_ms is not None
+                            else None),
+               "dense_oom": dense_ms is None,
                "flash_ms": round(flash_ms, 3),
-               "speedup": (round(dense_ms / flash_ms, 2) if dense_ms
-                           else None),
+               "speedup": (round(dense_ms / flash_ms, 2)
+                           if dense_ms is not None else None),
                "device": jax.devices()[0].device_kind}
         rows.append(row)
         print(json.dumps(row))

@@ -70,9 +70,10 @@ def main():
                             "t": t, "dh": dh, "bq": bq, "bk": bk,
                             "flash_ms": round(ms, 3),
                             "dense_ms": (round(dense_ms, 3)
-                                         if dense_ms else None),
+                                         if dense_ms is not None else None),
+                            "dense_oom": dense_ms is None,
                             "speedup": (round(dense_ms / ms, 2)
-                                        if dense_ms else None)}))
+                                        if dense_ms is not None else None)}))
                     except Exception as e:
                         print(json.dumps({"t": t, "dh": dh, "bq": bq,
                                           "bk": bk,

@@ -59,9 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--schedule', choices=("gpipe", "1f1b"), default="gpipe",
                    help="pipeline schedule: gpipe = scanned fwd sweep + "
                         "autodiff backward (activation memory grows with "
-                        "microbatches); 1f1b = interleaved one-forward-one-"
-                        "backward with recompute (memory bounded by the "
-                        "stage count; composes with --dp/--tp/--sp/--ep)")
+                        "microbatches); 1f1b = one-forward-one-backward "
+                        "(PipeDream-flush) with recompute (memory bounded by "
+                        "the stage count; composes with --dp/--tp/--sp/--ep)")
     g.add_argument('--dp', type=int, default=1,
                    help="data-parallel mesh width (batch must divide by "
                         "dp * microbatches)")

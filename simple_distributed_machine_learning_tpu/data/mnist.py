@@ -191,7 +191,10 @@ def prefetch_batches(ds: Dataset, batch_size: int,
 
     ``shuffle_seed``: seeded epoch shuffle; the permutation is applied to the
     (host-resident) arrays up front so the native prefetcher still streams
-    contiguous slices.
+    contiguous slices. NOTE: this materializes a full shuffled COPY of the
+    dataset each epoch — free at MNIST scale, but for datasets where 2x host
+    residency matters, prefer the index-based Python iterator
+    (:func:`batches`), which gathers per batch instead.
     """
     from simple_distributed_machine_learning_tpu.data import native_loader
 

@@ -24,7 +24,10 @@ import jax
 import jax.numpy as jnp
 
 from simple_distributed_machine_learning_tpu.ops.attention import (
+    _merge_heads,
+    _split_heads,
     causal_attention,
+    causal_attention_core,
     mha_init,
 )
 from simple_distributed_machine_learning_tpu.ops.layers import (
@@ -357,6 +360,170 @@ def generate(stages, prompt: jax.Array, n_new: int,
     dec = make_decoder(stages, int(prompt.shape[1]), n_new,
                        temperature=temperature)
     return dec([s.params for s in stages], prompt, key)
+
+
+def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
+                        temperature: float = 0.0):
+    """KV-cache decode: ``decode(params, prompt, key) -> [B, prompt_len+n_new]``.
+
+    Same contract as :func:`make_decoder` but O(T) per generated token instead
+    of O(T²): a one-shot prefill runs the prompt through every block once,
+    recording each layer's K/V projections into static ``[L, B, H, total, dh]``
+    cache buffers, and the decode ``lax.scan`` then pushes ONE token per step —
+    the new K/V row lands in the cache via ``lax.dynamic_update_slice`` and
+    attention is a single [1, total] masked row against the cache. Static
+    shapes throughout (the TPU decode idiom: no growing buffers, no retraces).
+
+    For ``attn_impl="dense"`` builds greedy tokens match :func:`make_decoder`
+    exactly (same math, different association; see
+    tests/test_gpt.py::test_cached_decoder_matches_recompute). The cached path
+    always computes DENSE attention math on the weights — an
+    ``attn_impl="flash"`` build decodes fine here (flash is the same math),
+    but ``make_decoder`` would run the Pallas kernel, whose different
+    accumulation order can flip a near-tie argmax; cross-decoder token
+    equality is only to float tolerance in that case.
+
+    Single-device dense-MLP composition only: MoE routing capacity is defined
+    per full sequence (``default_capacity(T, ...)``), so per-token routing
+    would silently change which tokens overflow — decode MoE models with
+    :func:`make_decoder`. Sequence-parallel builds (``cfg.n_seq > 1``) use mesh
+    collectives in their applies and cannot run here either (same restriction
+    as :func:`make_decoder`).
+
+    The reference has no inference path at all (eval only,
+    ``/root/reference/simple_distributed.py:119-132``).
+    """
+    from jax import lax
+
+    if cfg.n_experts > 0:
+        raise ValueError(
+            "make_cached_decoder supports dense-MLP blocks only — MoE "
+            "capacity is a full-sequence quantity, so per-token cached "
+            "routing would change overflow behavior; use make_decoder")
+    if cfg.n_seq > 1:
+        raise ValueError(
+            "cached decode is single-device; rebuild the stages with n_seq=1 "
+            "(same weights) as make_decoder requires too")
+    if prompt_len < 1:
+        raise ValueError(
+            "generate needs a non-empty prompt (t0 >= 1): the first decoded "
+            "token is conditioned on the prompt's last position")
+    if n_new < 1:
+        raise ValueError("make_cached_decoder needs n_new >= 1 (there is "
+                         "nothing to cache for a pure-prefill call)")
+    total = prompt_len + n_new
+    if total > cfg.seq_len:
+        raise ValueError(
+            f"prompt {prompt_len} + n_new {n_new} exceeds the model's "
+            f"sequence length {cfg.seq_len}")
+    import math
+
+    H, d = cfg.n_heads, cfg.d_model
+    dh = d // H
+    # validate cfg against the stages' ACTUAL build shapes — a mismatched cfg
+    # would otherwise fail silently (JAX clamps an out-of-range pos-table
+    # dynamic_slice instead of raising, so decode would quietly reuse the
+    # last positional embedding past the real seq_len)
+    pos = stages[0].params["embed"]["pos"]
+    if pos.shape != (cfg.seq_len, cfg.d_model):
+        raise ValueError(
+            f"cfg (seq_len={cfg.seq_len}, d_model={cfg.d_model}) does not "
+            f"match the stages' embedding table {pos.shape} — pass the "
+            f"GPTConfig the stages were built with")
+
+    def _merged(params_list):
+        """Re-join the per-stage trees into (embed, blocks, head)."""
+        embed = head = None
+        blocks = []
+        for p in params_list:
+            blocks.extend(p["blocks"])
+            embed = p.get("embed", embed)
+            head = p.get("head", head)
+        return embed, blocks, head
+
+    def _head_row(head, h_last):
+        """[B, d] final hidden -> [B, V] log-probs."""
+        return log_softmax(linear(head["out"],
+                                  layer_norm(head["ln_f"], h_last)))
+
+    def _pick(row, k):
+        if temperature > 0.0:
+            k, ks = jax.random.split(k)
+            return jax.random.categorical(ks, row / temperature, axis=-1), k
+        return jnp.argmax(row, axis=-1), k
+
+    def _qkv(bp, h):
+        """ln1 + QKV projections — shared by prefill and decode step so the
+        two paths stay provably identical."""
+        hn = layer_norm(bp["ln1"], h)
+        return (_split_heads(hn @ bp["attn"]["wq"], H),
+                _split_heads(hn @ bp["attn"]["wk"], H),
+                _split_heads(hn @ bp["attn"]["wv"], H))
+
+    def _attn_tail(bp, h, a):
+        """wo merge + residual + ln2 + MLP + residual (the dense block tail)."""
+        h = h + _merge_heads(a) @ bp["attn"]["wo"]
+        hn2 = layer_norm(bp["ln2"], h)
+        return h + linear(bp["mlp_out"], jax.nn.gelu(linear(bp["mlp_in"], hn2)))
+
+    @jax.jit
+    def decode(params, prompt, key):
+        embed, blocks, head = _merged(params)
+        b = prompt.shape[0]
+        L = len(blocks)
+        kc = jnp.zeros((L, b, H, total, dh), jnp.float32)
+        vc = jnp.zeros((L, b, H, total, dh), jnp.float32)
+
+        # --- prefill: one dense causal pass over the whole prompt, recording
+        # every layer's K/V rows for positions [0, prompt_len)
+        ids = prompt.astype(jnp.int32)
+        h = embedding_lookup(embed["tok"], ids) + embed["pos"][:prompt_len]
+        for li, bp in enumerate(blocks):
+            q, k, v = _qkv(bp, h)
+            kc = kc.at[li, :, :, :prompt_len].set(k)
+            vc = vc.at[li, :, :, :prompt_len].set(v)
+            h = _attn_tail(bp, h, causal_attention_core(q, k, v))
+        row = _head_row(head, h[:, -1])
+        tok, key = _pick(row, key)          # token for position prompt_len
+
+        # --- decode: one token per step; the input token sits at position i,
+        # its K/V row lands at cache index i, and the masked attention row
+        # covers positions [0, i]
+        def step(carry, i):
+            kc, vc, tok, k = carry
+            pos = lax.dynamic_slice_in_dim(embed["pos"], i, 1, 0)
+            h = embedding_lookup(embed["tok"], tok[:, None]) + pos   # [B,1,d]
+            for li, bp in enumerate(blocks):
+                q, knew, vnew = _qkv(bp, h)                   # [B,H,1,dh] each
+                kc = lax.dynamic_update_slice(kc, knew[None],
+                                              (li, 0, 0, i, 0))
+                vc = lax.dynamic_update_slice(vc, vnew[None],
+                                              (li, 0, 0, i, 0))
+                # same scale expression as causal_attention_core (divide by
+                # sqrt(dh)) so prefill and step compile to identical math
+                scores = (jnp.einsum("bhqd,bhkd->bhqk", q, kc[li])
+                          / math.sqrt(dh))
+                live = (jnp.arange(total) <= i)[None, None, None, :]
+                scores = jnp.where(live, scores, -jnp.inf)
+                a = jnp.einsum("bhqk,bhkd->bhqd",
+                               jax.nn.softmax(scores, axis=-1), vc[li])
+                h = _attn_tail(bp, h, a)
+            row = _head_row(head, h[:, 0])
+            nxt, k = _pick(row, k)
+            return (kc, vc, nxt, k), tok
+
+        # steps i = prompt_len .. total-2 each CONSUME the carried token at
+        # position i and emit it, producing the next; the final carried token
+        # (position total-1) is appended after the scan
+        (_, _, last, _), toks = lax.scan(
+            step, (kc, vc, tok, key), prompt_len + jnp.arange(n_new - 1))
+        out = jnp.concatenate(
+            [prompt.astype(jnp.int32),
+             jnp.moveaxis(toks, 0, 1),
+             last[:, None]], axis=1)
+        return out
+
+    return decode
 
 
 def make_decoder(stages, prompt_len: int, n_new: int,

@@ -1,4 +1,4 @@
-"""1F1B pipeline schedule: interleaved forward/backward with O(S) memory.
+"""1F1B pipeline schedule: alternating forward/backward with O(S) memory.
 
 The GPipe engine (``pipeline.py``) differentiates its scanned forward with
 ``jax.grad``: XLA runs the whole forward sweep first, so every one of the
@@ -98,11 +98,8 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     ``grads`` shaped/sharded like the packed param buffer. Inputs are the
     ``Pipeline._prep_inputs`` layout.
     """
-    if pipe.n_seq > 1 and len(pipe.out_shape) < 2:
-        raise ValueError(
-            "1F1B on a seq-parallel mesh needs a per-token output shape "
-            "(a classifier has no token axis to shard); use "
-            "schedule='gpipe'")
+    # (seq-parallel + classifier out_shape is rejected by Pipeline.__init__
+    # before any schedule is built — no separate guard here)
     if pipe.n_stages < 2:
         raise ValueError("1F1B needs >= 2 pipeline stages")
 

@@ -186,3 +186,52 @@ def test_generate_sampling_shapes_and_validation():
         generate(stages, prompt, n_new=13)
     with pytest.raises(ValueError, match="needs a PRNG key"):
         generate(stages, prompt, n_new=2, temperature=0.5)
+
+
+def test_cached_decoder_matches_recompute():
+    """KV-cache greedy decode produces the exact token sequence of the
+    full-prefix-recompute decoder: same math, cache rows replace the O(T^2)
+    re-forward. Covers multi-stage param re-joining (embed on stage 0, head
+    on the last) and a prompt_len=1 prefill."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_cached_decoder,
+        make_decoder,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=24, d_model=32, n_heads=2, n_layers=2)
+    for n_stages, t0, n_new in [(1, 6, 10), (2, 6, 10), (2, 1, 8)]:
+        stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages)
+        params = [s.params for s in stages]
+        prompt = jax.random.randint(jax.random.key(1), (2, t0), 0, cfg.vocab)
+        want = make_decoder(stages, t0, n_new)(
+            params, prompt, jax.random.key(0))
+        got = make_cached_decoder(stages, cfg, t0, n_new)(
+            params, prompt, jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_cached_decoder_validation():
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_cached_decoder,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
+    with pytest.raises(ValueError, match="exceeds the model's sequence"):
+        make_cached_decoder(stages, cfg, 8, 9)
+    with pytest.raises(ValueError, match="n_new >= 1"):
+        make_cached_decoder(stages, cfg, 8, 0)
+
+    wrong = GPTConfig(vocab=32, seq_len=64, d_model=32, n_heads=2, n_layers=2)
+    with pytest.raises(ValueError, match="does not match the stages'"):
+        make_cached_decoder(stages, wrong, 8, 4)
+
+    moe = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2,
+                    n_experts=4)
+    moe_stages, _, _ = make_gpt_stages(jax.random.key(0), moe, n_stages=1)
+    with pytest.raises(ValueError, match="dense-MLP blocks only"):
+        make_cached_decoder(moe_stages, moe, 4, 4)

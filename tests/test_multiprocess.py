@@ -310,7 +310,8 @@ def test_four_process_dp_pp(tmp_path):
 
 
 def test_two_process_launch_1f1b(tmp_path):
-    """The 1F1B interleaved schedule across a REAL process boundary: both
+    """The 1F1B schedule (non-interleaved PipeDream-flush) across a REAL
+    process boundary: both
     rings (forward activations, backward cotangents) cross the gloo
     transport every tick, with the scheduled+clipped optimizer in the same
     compiled step."""
