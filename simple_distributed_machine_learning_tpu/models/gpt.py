@@ -406,8 +406,8 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
             "(same weights) as make_decoder requires too")
     if prompt_len < 1:
         raise ValueError(
-            "generate needs a non-empty prompt (t0 >= 1): the first decoded "
-            "token is conditioned on the prompt's last position")
+            "make_cached_decoder needs a non-empty prompt (t0 >= 1): the "
+            "first decoded token is conditioned on the prompt's last position")
     if n_new < 1:
         raise ValueError("make_cached_decoder needs n_new >= 1 (there is "
                          "nothing to cache for a pure-prefill call)")

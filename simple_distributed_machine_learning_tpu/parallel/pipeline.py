@@ -387,8 +387,8 @@ class Pipeline:
 
     # ---- forward/loss ---------------------------------------------------
 
-    def _shard_fn(self, deterministic: bool, loss_only: bool = False
-                  ) -> Callable:
+    def _shard_fn(self, deterministic: bool, loss_only: bool = False,
+                  metrics: bool = False) -> Callable:
         """Build (once per mode) the shard_mapped pipeline loss function.
 
         ``loss_only``: the training mode. The scan carry drops the
@@ -397,10 +397,20 @@ class Pipeline:
         dominant activation at scale) and the function returns just the
         scalar loss; gradients are identical because the accumulator never
         feeds the loss.
+
+        ``metrics``: the eval mode. Like ``loss_only`` the carry never holds
+        the log-probs accumulator; instead the loop folds each last-stage
+        microbatch's log-probs straight into three scalars — weighted NLL
+        sum, weight sum, weighted argmax-correct count — and returns them
+        un-divided (the caller decides mean vs sum). Eval of a model whose
+        ``[B, T, V]`` logits would not fit replicated across stages costs no
+        more memory than training.
         """
-        cache_key = (deterministic, loss_only)
+        cache_key = (deterministic, loss_only, metrics)
         if cache_key in self._sm_cache:
             return self._sm_cache[cache_key]
+        if loss_only and metrics:
+            raise ValueError("loss_only and metrics are distinct modes")
 
         S = self.n_stages
         M = self.n_microbatches
@@ -512,6 +522,8 @@ class Pipeline:
             def step(carry, t):
                 if loss_only:
                     wire, num_acc, den_acc, aux_acc = carry
+                elif metrics:
+                    wire, num_acc, den_acc, aux_acc, correct_acc = carry
                 else:
                     wire, num_acc, den_acc, aux_acc, logits_acc = carry
                 # stage 0 injects a fresh microbatch every step (clipped so the
@@ -554,6 +566,18 @@ class Pipeline:
                 wire = lax.ppermute(out, STAGE_AXIS, fwd)
                 if loss_only:
                     return (wire, num_acc, den_acc, aux_acc), None
+                if metrics:
+                    # fold the microbatch's log-probs into the correct count
+                    # right here — they never outlive this scan step. The
+                    # count is int32 (exact to 2^31; a float32 running sum
+                    # silently drops increments past 2^24 ≈ 16.7M tokens) and
+                    # counts predictions whose weight is NONZERO — identical
+                    # to the weighted sum for 0/1 validity masks, which is
+                    # what a count of "correct predictions" means
+                    hit = (logits.argmax(-1) == tgt) & (per_tok > 0)
+                    correct_acc = correct_acc + jnp.where(
+                        is_out, jnp.sum(hit.astype(jnp.int32)), 0)
+                    return (wire, num_acc, den_acc, aux_acc, correct_acc), None
                 prev = lax.dynamic_index_in_dim(logits_acc, m_safe, 0, keepdims=False)
                 logits_acc = lax.dynamic_update_index_in_dim(
                     logits_acc, jnp.where(is_out, logits, prev), m_safe, 0)
@@ -565,12 +589,16 @@ class Pipeline:
             # carry types for check_vma
             init0 = (jnp.zeros((mb, wire_dim), x_mb.dtype),
                      jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
-            if not loss_only:
+            if metrics:
+                init0 += (jnp.int32(0),)
+            elif not loss_only:
                 init0 += (jnp.zeros((M, mb) + out_shape, jnp.float32),)
             init = jax.tree.map(lambda a: _pvary_to(a, vary_axes), init0)
             carry_out, _ = lax.scan(step, init, jnp.arange(T))
             if loss_only:
                 _, num, den, aux = carry_out
+            elif metrics:
+                _, num, den, aux, correct = carry_out
             else:
                 _, num, den, aux, logits_acc = carry_out
 
@@ -582,6 +610,23 @@ class Pipeline:
             if seq_on:
                 num = lax.psum(num, SEQ_AXIS)
                 den = lax.psum(den, SEQ_AXIS)
+            if metrics:
+                # correct reduces exactly like num: only the last stage
+                # contributed, data (and seq) shards partition the samples
+                # (tokens), model/expert slots replicate. The replication
+                # proof over model/expert stays integer-exact as psum//size
+                # (identical replicas sum to size*v) instead of a float pmean
+                correct = lax.psum(lax.psum(correct, STAGE_AXIS), DATA_AXIS)
+                if seq_on:
+                    correct = lax.psum(correct, SEQ_AXIS)
+                num = lax.pmean(num, MODEL_AXIS)
+                den = lax.pmean(den, MODEL_AXIS)
+                correct = lax.psum(correct, MODEL_AXIS) // n_model
+                if self._has_expert:
+                    num = lax.pmean(num, EXPERT_AXIS)
+                    den = lax.pmean(den, EXPERT_AXIS)
+                    correct = lax.psum(correct, EXPERT_AXIS) // n_expert
+                return num, den, correct
             # model-axis replication proof for check_vma: every model slot
             # computed the same value (replicated stages run redundantly; TP
             # stages end each pair in their own psum), so pmean is the
@@ -634,6 +679,7 @@ class Pipeline:
                       P(None, DATA_AXIS, *tgt_tok),
                       P(None, DATA_AXIS), P()),
             out_specs=(P() if loss_only
+                       else (P(), P(), P()) if metrics
                        else (P(), P(None, DATA_AXIS, *tgt_tok, None))),
         )
         self._sm_cache[cache_key] = fn
@@ -666,6 +712,36 @@ class Pipeline:
         xw, tgt, w = self._prep_inputs(x, targets, weights)
         loss, logits = self._shard_fn(deterministic)(buf, xw, tgt, w, key)
         return loss, logits.reshape((x.shape[0],) + self.out_shape)
+
+    def eval_metrics(self, buf: jax.Array, x: jax.Array, targets: jax.Array,
+                     key: jax.Array, weights: jax.Array | None = None
+                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """``(sum_nll, sum_weight, correct)`` — the memory-flat eval path.
+
+        ``sum(w·nll)`` and ``sum(w)`` are weighted sums over the global
+        batch with ``w`` broadcast over any token axes (so a per-sample 0/1
+        validity mask zeroes padded rows of a ragged batch); ``correct`` is
+        the int32 COUNT of predictions with ``argmax == target`` among
+        nonzero-weight entries — an integer accumulation exact to 2^31
+        (a float32 weighted sum would silently stop counting past ~16.7M).
+        Always deterministic (dropout off — deliberately NOT the reference's
+        eval-dropout quirk, SURVEY §3.5).
+
+        Unlike ``loss_and_logits``, nothing ``[batch, *out_shape]``-sized is
+        materialized, carried, or psum'd: each last-stage microbatch's
+        log-probs fold into the three scalars inside the scan step. For a
+        vocab-wide LM the logits accumulator is the dominant eval
+        activation — this path removes it, so eval fits wherever training
+        fits (``make_eval_step`` builds on this).
+        """
+        if self._trivial_mesh():
+            logp, _ = self._fused_logits(buf, x, key, True)
+            num, den, wb = _weighted_nll_sums(logp, targets, weights)
+            hit = (logp.argmax(-1) == targets) & (wb > 0)
+            return num, den, jnp.sum(hit.astype(jnp.int32))
+        xw, tgt, w = self._prep_inputs(x, targets, weights)
+        return self._shard_fn(deterministic=True, metrics=True)(
+            buf, xw, tgt, w, key)
 
     def loss(self, buf: jax.Array, x: jax.Array, targets: jax.Array,
              key: jax.Array, deterministic: bool = False,
@@ -759,15 +835,9 @@ class Pipeline:
              else weights.astype(jnp.float32)).reshape(M, B // M)
         return xw, tgt, w
 
-    def _fused_loss(self, buf, x, targets, key, deterministic, weights):
-        """Single-device fast path. Identical to the engine for
-        ``n_microbatches == 1`` or deterministic mode (same RNG stream: the
-        engine's stage-0 key at step 0 on data shard 0); with several
-        microbatches AND dropout the engine draws per-microbatch noise while
-        this path draws one batch-wide key — same distribution, different
-        stream."""
-        import jax.numpy as jnp
-
+    def _fused_logits(self, buf, x, key, deterministic):
+        """Single-device forward: ``(log_probs, aux)`` from the fused stage.
+        Same RNG stream as the engine's stage-0 key at step 0, data shard 0."""
         B = x.shape[0]
         stage = self.stages[0]
         params = unpack_stage_params(buf[0, 0, 0], self.metas[0])
@@ -783,14 +853,29 @@ class Pipeline:
         if isinstance(out, tuple):
             out, aux = out
             aux = aux.astype(jnp.float32)
-        logp = out.astype(jnp.float32)
-        nll = nll_loss(logp, targets, "none")
-        w = (jnp.ones((B,), jnp.float32) if weights is None
-             else weights.astype(jnp.float32))
-        wb = jnp.broadcast_to(
-            w.reshape(w.shape + (1,) * (nll.ndim - 1)), nll.shape)
-        loss = jnp.sum(nll * wb) / jnp.maximum(jnp.sum(wb), 1e-12) + aux
-        return loss, logp
+        return out.astype(jnp.float32), aux
+
+    def _fused_loss(self, buf, x, targets, key, deterministic, weights):
+        """Single-device fast path. Identical to the engine for
+        ``n_microbatches == 1`` or deterministic mode; with several
+        microbatches AND dropout the engine draws per-microbatch noise while
+        this path draws one batch-wide key — same distribution, different
+        stream."""
+        logp, aux = self._fused_logits(buf, x, key, deterministic)
+        num, den, _ = _weighted_nll_sums(logp, targets, weights)
+        return num / jnp.maximum(den, 1e-12) + aux, logp
+
+
+def _weighted_nll_sums(logp, targets, weights):
+    """``(sum(w·nll), sum(w), wb)`` with per-sample ``weights`` (or ones)
+    broadcast over token axes — the one copy of the weighted-metrics
+    arithmetic shared by the fused loss and eval paths."""
+    nll = nll_loss(logp, targets, "none")
+    w = (jnp.ones((logp.shape[0],), jnp.float32) if weights is None
+         else weights.astype(jnp.float32))
+    wb = jnp.broadcast_to(
+        w.reshape(w.shape + (1,) * (nll.ndim - 1)), nll.shape)
+    return jnp.sum(nll * wb), jnp.sum(wb), wb
 
 
 def fused_reference(stages: Sequence[Stage]) -> Callable:

@@ -176,20 +176,21 @@ def make_eval_step(pipe: Pipeline):
     ``n_valid`` masks zero-padded trailing rows of a ragged final batch (the
     compiled pipeline needs static shapes; the reference's DataLoader just
     emits a short batch, ``simple_distributed.py:95``).
+
+    Memory: built on ``Pipeline.eval_metrics`` — the sums are computed
+    inside the shard_map scan, so no ``[batch, *out_shape]`` logits tensor
+    is ever materialized or replicated across stages (eval fits wherever
+    training fits, even for vocab-wide LM outputs).
     """
     import jax.numpy as jnp
 
-    from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
-
     @jax.jit
     def step(buf, x, targets, key, n_valid):
-        _, logp = pipe.loss_and_logits(buf, x, targets, key, deterministic=True)
-        # per-sample mask, broadcast over any token axes (LM targets [B, T])
+        # per-sample 0/1 validity mask; eval_metrics broadcasts it over any
+        # token axes (LM targets [B, T])
         mask = (jnp.arange(x.shape[0]) < n_valid).astype(jnp.float32)
-        mask = mask.reshape((x.shape[0],) + (1,) * (targets.ndim - 1))
-        sum_loss = jnp.sum(nll_loss(logp, targets, reduction="none") * mask)
-        correct = jnp.sum(((logp.argmax(-1) == targets)
-                           * mask).astype(jnp.int32))
-        return sum_loss, correct
+        sum_loss, _, correct = pipe.eval_metrics(buf, x, targets, key,
+                                                 weights=mask)
+        return sum_loss, correct          # correct is exact int32
 
     return step
