@@ -231,14 +231,17 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
     head = {"ln_f": layer_norm_init(cfg.d_model),
             "out": linear_init(kh, cfg.d_model, cfg.vocab)}
 
-    per = [cfg.n_layers // n_stages + (1 if i < cfg.n_layers % n_stages else 0)
-           for i in range(n_stages)]
+    from simple_distributed_machine_learning_tpu.parallel.staging import (
+        contiguous_split,
+    )
+    block_split = (contiguous_split(blocks, n_stages) if blocks
+                   else [[] for _ in range(n_stages)])
     t_loc = cfg.seq_len // cfg.n_seq        # tokens per seq shard
 
     stages: list[Stage] = []
     start = 0
     for s in range(n_stages):
-        stage_blocks = blocks[start:start + per[s]]
+        stage_blocks = block_split[s]
         first, last = s == 0, s == n_stages - 1
         params: dict = {"blocks": stage_blocks}
         if first:
@@ -288,7 +291,7 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
                                 in_shape=in_shape, expert_shards=shards))
         else:
             stages.append(Stage(apply=apply, params=params, in_shape=in_shape))
-        start += per[s]
+        start += len(stage_blocks)
 
     # the wire carries only INTER-stage activations ([t_loc, d_model] blocks
     # and the stage-0 token ids); the last stage's [t_loc, vocab] log-probs

@@ -34,13 +34,15 @@ def make_mlp_stages(key: jax.Array, dims: Sequence[int], n_stages: int
     keys = jax.random.split(key, n_layers)
     layer_params = [linear_init(keys[i], dims[i], dims[i + 1])
                     for i in range(n_layers)]
-    per = [n_layers // n_stages + (1 if i < n_layers % n_stages else 0)
-           for i in range(n_stages)]
+    from simple_distributed_machine_learning_tpu.parallel.staging import (
+        contiguous_split,
+    )
+    split = contiguous_split(layer_params, n_stages)
 
     stages: list[Stage] = []
     start = 0
     for s in range(n_stages):
-        params = layer_params[start:start + per[s]]
+        params = split[s]
         is_last = s == n_stages - 1
 
         def apply(params, x, key, deterministic,
@@ -54,7 +56,7 @@ def make_mlp_stages(key: jax.Array, dims: Sequence[int], n_stages: int
 
         stages.append(Stage(apply=apply, params=params,
                             in_shape=(dims[start],)))
-        start += per[s]
+        start += len(params)
 
     wire_dim = max(dims)
     return stages, wire_dim, dims[-1]

@@ -33,6 +33,23 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def contiguous_split(units: Sequence[Any], n_stages: int) -> list[list]:
+    """Assign ``units`` (layers/blocks) contiguously to ``n_stages`` stages,
+    earlier stages taking the remainder — THE stage-distribution rule, shared
+    by every splittable model builder (models/mlp.py, models/gpt.py) and the
+    checkpoint repacker (train/checkpoint.py), so they can never drift."""
+    n = len(units)
+    if n < n_stages:
+        raise ValueError(f"{n} layers cannot fill {n_stages} stages")
+    per = [n // n_stages + (1 if i < n % n_stages else 0)
+           for i in range(n_stages)]
+    out, start = [], 0
+    for p in per:
+        out.append(list(units[start:start + p]))
+        start += p
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class StageMeta:
     """Static description of one stage's packed parameter layout."""

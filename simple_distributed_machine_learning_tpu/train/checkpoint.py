@@ -4,10 +4,19 @@ The reference has no persistence at all — a crash loses every epoch (SURVEY
 §5.4: no ``torch.save`` anywhere). Here the full training state — the
 stage-sharded parameter buffer, optimizer state, step counter and RNG seed —
 round-trips through a single ``.npz`` plus a JSON sidecar. Sharded arrays are
-gathered on save and re-placed with the pipeline's sharding on restore, so a
-checkpoint written on one mesh layout can resume on another (e.g. 2-stage →
-re-packed 4-stage requires matching stage structure; same-topology resume is
-bit-exact).
+gathered on save and re-placed with the pipeline's sharding on restore;
+same-topology resume is bit-exact.
+
+Cross-topology resume: a checkpoint written at one pipeline stage count can
+be re-packed for another via :func:`repack_checkpoint` (or
+``restore_checkpoint(..., src_pipe=...)``) for models whose stages are a
+CONTIGUOUS split of a unit sequence — per-stage trees that are plain lists
+of layers (the MLP family) or ``{"blocks": [...]}`` dicts with ``embed`` on
+the first stage and ``head`` on the last (the GPT family). Structurally
+renamed splits (LeNet's fixed conv|fc vs fused trees) are not re-packable
+and are rejected with an error. Buffer-shaped optimizer state (momentum,
+AdamW moments) re-packs alongside the params; the data/model/expert axis
+sizes must match between source and target.
 """
 
 from __future__ import annotations
@@ -142,11 +151,145 @@ def save_checkpoint_async(path: str, buf: jax.Array, opt_state: Any,
     return handle
 
 
-def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None
-                       ) -> dict:
+def _np_unpack(row: np.ndarray, meta) -> Any:
+    """Host-side unpack_stage_params (no device round-trip on restore)."""
+    leaves = []
+    offset = 0
+    for shape, size in zip(meta.shapes, meta.sizes):
+        leaves.append(np.asarray(row[offset:offset + size]).reshape(shape))
+        offset += size
+    return jax.tree.unflatten(meta.treedef, leaves)
+
+
+def _np_pack_row(tree: Any, width: int) -> np.ndarray:
+    leaves = jax.tree.flatten(tree)[0]
+    flat = (np.concatenate([np.ravel(np.asarray(l)).astype(np.float32)
+                            for l in leaves])
+            if leaves else np.zeros((0,), np.float32))
+    return np.pad(flat, (0, width - flat.shape[0]))
+
+
+def repack_stage_trees(trees: list, n_stages_new: int) -> list:
+    """Re-split per-stage param trees to a new contiguous stage count.
+
+    Two supported stage-tree conventions (the ones every splittable model
+    builder in this framework produces):
+
+    - every stage tree is a LIST of per-layer trees (MLP family): the lists
+      concatenate into the global layer sequence and re-split contiguously;
+    - every stage tree is a dict with a ``"blocks"`` list (GPT family):
+      blocks concatenate and re-split; the first stage's non-block keys
+      (``embed``) move to the new first stage, the last stage's (``head``)
+      to the new last. From a 1-stage (fused) source both live on the same
+      tree; the key named ``"head"`` is the one that moves to the new last
+      stage — the convention the GPT builder defines.
+
+    Anything else — structurally renamed splits like LeNet's conv|fc vs
+    fused trees — raises.
+    """
+    from simple_distributed_machine_learning_tpu.parallel.staging import (
+        contiguous_split,
+    )
+    if all(isinstance(t, list) for t in trees):
+        units = [u for t in trees for u in t]
+        return contiguous_split(units, n_stages_new)
+    if all(isinstance(t, dict) and "blocks" in t for t in trees):
+        for i, t in enumerate(trees[1:-1], start=1):
+            if set(t) != {"blocks"}:
+                raise ValueError(
+                    f"stage {i} carries non-block keys {sorted(set(t))} — "
+                    f"only the first (embed) and last (head) stages may")
+        if len(trees) > 1:
+            extras_first = {k: v for k, v in trees[0].items()
+                            if k != "blocks"}
+            extras_last = {k: v for k, v in trees[-1].items()
+                           if k != "blocks"}
+        else:
+            # fused source: embed and head share the one tree — "head" is
+            # the last-stage extra by convention, the rest go first
+            extras_first = {k: v for k, v in trees[0].items()
+                            if k not in ("blocks", "head")}
+            extras_last = {k: v for k, v in trees[0].items() if k == "head"}
+        blocks = [b for t in trees for b in t["blocks"]]
+        split = contiguous_split(blocks, n_stages_new)
+        out = []
+        for s, bs in enumerate(split):
+            t: dict = {"blocks": bs}
+            if s == 0:
+                t.update(extras_first)
+            if s == n_stages_new - 1:
+                t.update(extras_last)
+            out.append(t)
+        return out
+    raise ValueError(
+        "stages are not a contiguous split of a unit sequence (expected all "
+        "lists, or all dicts with a 'blocks' list); this topology cannot be "
+        "re-packed — rebuild and retrain, or restore at the original stage "
+        "count")
+
+
+def repack_packed_buffer(arr: np.ndarray, src_pipe, dst_pipe) -> np.ndarray:
+    """Re-split a packed ``[S_src, M, E, P_src]`` buffer (params, momentum,
+    AdamW moments — anything stage-packed) into ``dst_pipe``'s
+    ``[S_dst, M, E, P_dst]`` layout. Same model, different contiguous stage
+    split; the model/expert shard axes must match."""
+    if (src_pipe.n_model, src_pipe.n_expert) != (dst_pipe.n_model,
+                                                dst_pipe.n_expert):
+        raise ValueError(
+            f"model/expert axes must match to repack: source "
+            f"{src_pipe.n_model}x{src_pipe.n_expert}, target "
+            f"{dst_pipe.n_model}x{dst_pipe.n_expert}")
+    arr = np.asarray(arr)
+    want_src = tuple(src_pipe._buf0.shape)
+    if tuple(arr.shape) != want_src:
+        raise ValueError(
+            f"buffer {tuple(arr.shape)} does not match the source pipeline's "
+            f"packed layout {want_src}")
+    out = np.zeros_like(dst_pipe._buf0)
+    P_dst = out.shape[-1]
+    for m in range(src_pipe.n_model):
+        for e in range(src_pipe.n_expert):
+            trees = [_np_unpack(arr[s, m, e], src_pipe.metas[s])
+                     for s in range(src_pipe.n_stages)]
+            new_trees = repack_stage_trees(trees, dst_pipe.n_stages)
+            for s, t in enumerate(new_trees):
+                meta = dst_pipe.metas[s]
+                leaves = jax.tree.flatten(t)[0]
+                shapes = tuple(tuple(np.shape(l)) for l in leaves)
+                if shapes != meta.shapes:
+                    raise ValueError(
+                        f"re-split stage {s} leaf shapes {shapes} do not "
+                        f"match the target pipeline's {meta.shapes} — "
+                        f"source and target must build the same model")
+                out[s, m, e] = _np_pack_row(t, P_dst)
+    return out
+
+
+def repack_checkpoint(path_in: str, path_out: str, src_pipe, dst_pipe
+                      ) -> None:
+    """Rewrite a checkpoint written at ``src_pipe``'s topology into
+    ``dst_pipe``'s packed layout (params + every buffer-shaped optimizer
+    leaf; scalar leaves pass through). Single-process, host-side only."""
+    with np.load(path_in) as z:
+        meta = json.loads(bytes(z["_meta_json"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "_meta_json"}
+    src_shape = tuple(src_pipe._buf0.shape)
+    arrays["params"] = repack_packed_buffer(arrays["params"], src_pipe,
+                                            dst_pipe)
+    for k in list(arrays):
+        if k.startswith("opt_") and tuple(arrays[k].shape) == src_shape:
+            arrays[k] = repack_packed_buffer(arrays[k], src_pipe, dst_pipe)
+    _write_npz(path_out, arrays, meta)
+
+
+def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None,
+                       src_pipe=None) -> dict:
     """Load state. With ``pipe`` given, the param buffer is device_put with
     the pipeline's stage sharding; ``opt_treedef_like`` (e.g. ``opt.init(buf)``
-    output) restores the optimizer pytree structure."""
+    output) restores the optimizer pytree structure. ``src_pipe``: the
+    pipeline the checkpoint was WRITTEN with — when its stage count differs
+    from ``pipe``'s, params and buffer-shaped optimizer leaves are re-packed
+    (see :func:`repack_stage_trees` for the supported model conventions)."""
     with np.load(path) as z:
         meta = json.loads(bytes(z["_meta_json"]).decode())
         params = z["params"]
@@ -157,6 +300,13 @@ def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None
         from jax.sharding import NamedSharding
 
         want = tuple(pipe._buf0.shape)
+        if tuple(params.shape) != want and src_pipe is not None:
+            src_shape = tuple(src_pipe._buf0.shape)
+            params = repack_packed_buffer(params, src_pipe, pipe)
+            opt_leaves = [
+                (repack_packed_buffer(l, src_pipe, pipe)
+                 if tuple(l.shape) == src_shape else l)
+                for l in opt_leaves]
         if tuple(params.shape) != want:
             # pre-device_put check: an old-layout checkpoint (e.g. written
             # before a topology/model change) would otherwise die inside
@@ -164,7 +314,8 @@ def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None
             raise ValueError(
                 f"checkpoint {path} does not match the model: packed param "
                 f"buffer is {tuple(params.shape)}, model expects {want} "
-                f"(different model/topology config?)")
+                f"(different model/topology config? pass src_pipe= to "
+                f"re-pack a contiguous-split model across stage counts)")
         buf = jax.device_put(
             params, NamedSharding(pipe.mesh, pipe.param_spec()))
 

@@ -8,7 +8,8 @@ the north-star metric the reference never measured (SURVEY §6).
 
 MPMD→SPMD note (SURVEY §7 hard part (c)): the reference's loops run only on
 the master process while workers idle in an RPC serve loop. Here every process
-runs the same loop on the same (replicated) host batches; only process 0
+runs the same loop; on multi-process runs each host feeds only its data-axis
+rows of every batch (``_feed`` → ``data/sharding.py``), and only process 0
 prints (``is_main``).
 """
 
@@ -67,6 +68,11 @@ class TrainConfig:
     # fixed order, simple_distributed.py:94-95 — kept as the default for
     # loss-curve parity)
     shuffle: bool = False
+    # per-host input sharding (multi-process runs): each process feeds only
+    # its data-axis rows of every batch instead of materializing the global
+    # batch on every host (data/sharding.py). On a single process this is a
+    # no-op path and the plain numpy feed is used.
+    shard_inputs: bool = True
 
 
 class Trainer:
@@ -92,6 +98,10 @@ class Trainer:
         self._pending_save = None
         self.start_epoch = 1
         self.is_main = jax.process_index() == 0
+        self._shard_inputs = (self.config.shard_inputs
+                              and jax.process_count() > 1)
+        self._shard_announced = False
+        self._host_rows_cache: dict[int, tuple[int, int]] = {}
         if self.config.checkpoint_dir and self.config.resume:
             self._maybe_resume()
 
@@ -160,6 +170,44 @@ class Trainer:
         if self.is_main:
             print(msg)
 
+    def _feed(self, x, y, w):
+        """Batch feed: per-host data-axis slices assembled into global
+        arrays on multi-process runs, plain numpy otherwise.
+
+        The slice is taken host-side BEFORE any device transfer, so each
+        host's memory traffic is rows/dp, not the global batch — the correct
+        multi-host mapping of the reference's master-only loading
+        (simple_distributed.py:87-95, SURVEY §7 hard part (c))."""
+        if not self._shard_inputs:
+            return x, y, w
+        import os
+        import sys
+
+        from simple_distributed_machine_learning_tpu.data.sharding import (
+            host_rows,
+            make_global_batch,
+        )
+        B = len(x)
+        # (mesh, B) -> rows is run-invariant; don't pay the sharding-map
+        # query on every hot-loop step (train and eval batches are padded to
+        # a constant size, so this caches exactly one or two entries)
+        lo_hi = self._host_rows_cache.get(B)
+        if lo_hi is None:
+            lo_hi = self._host_rows_cache[B] = host_rows(self.pipe.mesh, B)
+        lo, hi = lo_hi
+        if not self._shard_announced:
+            self._shard_announced = True
+            if os.environ.get("SDML_DEBUG_SHARDING"):
+                # stderr + every rank: diagnostics must not touch the
+                # reference-format (rank-0-only) stdout surface
+                print(f"| host {jax.process_index()}: input rows "
+                      f"[{lo},{hi}) of {B}", file=sys.stderr, flush=True)
+        mesh = self.pipe.mesh
+        xg = make_global_batch(mesh, x[lo:hi], B)
+        yg = make_global_batch(mesh, y[lo:hi], B)
+        wg = None if w is None else make_global_batch(mesh, w[lo:hi], B)
+        return xg, yg, wg
+
     def train_epoch(self, epoch: int) -> float:
         cfg = self.config
         meter = Throughput()
@@ -179,8 +227,9 @@ class Trainer:
             w = None
             if b.n_valid < len(b.x):
                 w = (np.arange(len(b.x)) < b.n_valid).astype(np.float32)
+            x, y, w = self._feed(b.x, b.y, w)
             self.buf, self.opt_state, loss = self._train_step(
-                self.buf, self.opt_state, b.x, b.y, key, w)
+                self.buf, self.opt_state, x, y, key, w)
             self._step_count += 1
             meter.update(b.n_valid)
             if batch_idx == 0:
@@ -207,7 +256,8 @@ class Trainer:
         # language models (y: [N, T]) — y.size covers both
         n = int(self.test_ds.y.size)
         for b in batches(self.test_ds, cfg.batch_size, pad_last=True):
-            sl, c = self._eval_step(self.buf, b.x, b.y, self._key,
+            x, y, _ = self._feed(b.x, b.y, None)
+            sl, c = self._eval_step(self.buf, x, y, self._key,
                                     np.int32(b.n_valid))
             total_loss += float(sl)
             correct += int(c)

@@ -167,3 +167,155 @@ def test_resume_scalar_opt_state_on_multidevice_mesh():
             step2 = make_train_step(pipe, opt)
             b2, s2, loss = step2(ck["params"], ck["opt_state"], x, y, key)
             assert np.isfinite(float(loss))
+
+
+def test_repack_mlp_2_to_4_stage_trajectory_matches(tmp_path):
+    """Cross-topology resume: train 2-stage, checkpoint, resume 4-stage via
+    src_pipe repacking — params AND momentum land in the new layout, and the
+    continued trajectory matches continuing at 2 stages (the engines are
+    parity-tested across topologies, so identical state must give identical
+    losses to float tolerance)."""
+    key = jax.random.key(0)
+    dims = [12, 16, 14, 16, 10]
+    stages2, wd, od = make_mlp_stages(key, dims, 2)
+    pipe2 = Pipeline(stages2, make_mesh(n_stages=2, n_data=1,
+                                        devices=jax.devices()[:2]), wd, od)
+    opt = sgd(0.1, 0.5)
+    buf, state = pipe2.init_params(), None
+    state = opt.init(buf)
+    step2 = make_train_step(pipe2, opt)
+    x = jax.random.normal(key, (8, 12))
+    y = jax.random.randint(key, (8,), 0, 10)
+    for i in range(3):
+        buf, state, _ = step2(buf, state, x, y, jax.random.fold_in(key, i))
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, buf, state, step=3)
+
+    # continue at 2 stages (ground truth)
+    losses_a = []
+    buf_a, state_a = buf, state
+    for i in range(3, 6):
+        buf_a, state_a, l = step2(buf_a, state_a, x, y,
+                                  jax.random.fold_in(key, i))
+        losses_a.append(float(l))
+
+    # resume at 4 stages from the same checkpoint
+    stages4, wd4, od4 = make_mlp_stages(key, dims, 4)
+    pipe4 = Pipeline(stages4, make_mesh(n_stages=4, n_data=1,
+                                        devices=jax.devices()[:4]), wd4, od4)
+    ck = restore_checkpoint(path, pipe=pipe4,
+                            opt_treedef_like=opt.init(pipe4.init_params()),
+                            src_pipe=pipe2)
+    buf_b, state_b = ck["params"], ck["opt_state"]
+    step4 = make_train_step(pipe4, opt)
+    losses_b = []
+    for i in range(3, 6):
+        buf_b, state_b, l = step4(buf_b, state_b, x, y,
+                                  jax.random.fold_in(key, i))
+        losses_b.append(float(l))
+    np.testing.assert_allclose(losses_a, losses_b, rtol=2e-5, atol=2e-5)
+
+
+def test_repack_gpt_blocks_embed_head(tmp_path):
+    """The GPT convention: blocks re-split, embed sticks to the first stage,
+    head to the last; the repacked 4-stage model computes the same function
+    (same loss on the same batch)."""
+    import jax.numpy as jnp
+
+    from simple_distributed_machine_learning_tpu.data.text import (
+        synthetic_tokens,
+    )
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        repack_checkpoint,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=4)
+    s2, wd, osh = make_gpt_stages(jax.random.key(0), cfg, 2)
+    pipe2 = Pipeline(s2, make_mesh(n_stages=2, n_data=1,
+                                   devices=jax.devices()[:2]), wd, osh)
+    s4, wd4, osh4 = make_gpt_stages(jax.random.key(1), cfg, 4)
+    pipe4 = Pipeline(s4, make_mesh(n_stages=4, n_data=1,
+                                   devices=jax.devices()[:4]), wd4, osh4)
+    opt = sgd(0.1, 0.5)
+    buf2 = pipe2.init_params()
+    p_in = str(tmp_path / "in.npz")
+    p_out = str(tmp_path / "out.npz")
+    save_checkpoint(p_in, buf2, opt.init(buf2), step=0)
+    repack_checkpoint(p_in, p_out, pipe2, pipe4)
+    ck = restore_checkpoint(p_out, pipe=pipe4,
+                            opt_treedef_like=opt.init(pipe4.init_params()))
+
+    data = synthetic_tokens(4, cfg.seq_len, cfg.vocab, seed=1)
+    x = jnp.asarray(data.x, jnp.float32)
+    y = jnp.asarray(data.y)
+    key = jax.random.key(2)
+    l2, lp2 = pipe2.loss_and_logits(buf2, x, y, key, deterministic=True)
+    l4, lp4 = pipe4.loss_and_logits(ck["params"], x, y, key,
+                                    deterministic=True)
+    np.testing.assert_allclose(float(l2), float(l4), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lp2), np.asarray(lp4), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_repack_rejects_structural_renames():
+    """LeNet's 1-stage fused tree is a structural rename of its 2-stage
+    split, not a contiguous re-split — must be rejected loudly."""
+    import pytest
+
+    from simple_distributed_machine_learning_tpu.models.lenet import (
+        make_lenet_stages,
+    )
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        repack_packed_buffer,
+    )
+
+    s2, wd, od = make_lenet_stages(jax.random.key(0), 2)
+    pipe2 = Pipeline(s2, make_mesh(n_stages=2, n_data=1,
+                                   devices=jax.devices()[:2]), wd, od)
+    s1, wd1, od1 = make_lenet_stages(jax.random.key(0), 1)
+    pipe1 = Pipeline(s1, make_mesh(n_stages=1, n_data=1,
+                                   devices=jax.devices()[:1]), wd1, od1)
+    with pytest.raises(ValueError, match="cannot be re-packed"):
+        repack_packed_buffer(pipe2._buf0, pipe2, pipe1)
+
+
+def test_repack_gpt_fused_1_stage_to_pipeline():
+    """1-stage (fused) -> 2-stage: the single tree's 'head' moves to the new
+    last stage, 'embed' stays first; the scaled-out model computes the same
+    function."""
+    import jax.numpy as jnp
+
+    from simple_distributed_machine_learning_tpu.data.text import (
+        synthetic_tokens,
+    )
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        repack_packed_buffer,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2)
+    s1, wd1, osh1 = make_gpt_stages(jax.random.key(0), cfg, 1)
+    pipe1 = Pipeline(s1, make_mesh(n_stages=1, n_data=1,
+                                   devices=jax.devices()[:1]), wd1, osh1)
+    s2, wd2, osh2 = make_gpt_stages(jax.random.key(1), cfg, 2)
+    pipe2 = Pipeline(s2, make_mesh(n_stages=2, n_data=1,
+                                   devices=jax.devices()[:2]), wd2, osh2)
+    buf2 = jax.device_put(
+        repack_packed_buffer(pipe1._buf0, pipe1, pipe2),
+        jax.sharding.NamedSharding(pipe2.mesh, pipe2.param_spec()))
+
+    data = synthetic_tokens(4, cfg.seq_len, cfg.vocab, seed=3)
+    x = jnp.asarray(data.x, jnp.float32)
+    y = jnp.asarray(data.y)
+    key = jax.random.key(4)
+    l1, _ = pipe1.loss_and_logits(pipe1.init_params(), x, y, key,
+                                  deterministic=True)
+    l2, _ = pipe2.loss_and_logits(buf2, x, y, key, deterministic=True)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=2e-5, atol=2e-5)

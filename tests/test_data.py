@@ -75,3 +75,25 @@ def test_shuffled_batches_permute_deterministically():
     for b in pf:
         np.testing.assert_array_equal(b.x[:b.n_valid, 0],
                                       b.y[:b.n_valid].astype(np.float32))
+
+
+def test_per_host_sharding_single_process():
+    """Single process addresses the whole mesh: host_rows is the full range
+    and make_global_batch reassembles exactly (the multi-process behavior —
+    each host materializing 1/dp — is asserted cross-process in
+    tests/test_multiprocess.py::test_four_process_dp_pp)."""
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.data.sharding import (
+        host_rows,
+        make_global_batch,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_stages=2, n_data=2)
+    assert host_rows(mesh, 60) == (0, 60)
+    x = np.arange(60 * 3, dtype=np.float32).reshape(60, 3)
+    g = make_global_batch(mesh, x, 60)
+    assert isinstance(g, jax.Array) and g.shape == (60, 3)
+    np.testing.assert_array_equal(np.asarray(g), x)

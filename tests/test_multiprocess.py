@@ -18,6 +18,10 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # real OS-process launches: per-round gate
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -47,10 +51,12 @@ def _launch_rank(rank: int, port: int, extra: list[str],
                             env=env, cwd=REPO)
 
 
-def run_ranks(extra: list[str], timeout: int = 420, world_size: int = 2
+def run_ranks(extra: list[str], timeout: int = 420, world_size: int = 2,
+              env_extra: dict | None = None
               ) -> tuple[subprocess.CompletedProcess, ...]:
     port, hb_port = _free_port(), _free_port()
-    procs = [_launch_rank(r, port, extra, world_size=world_size,
+    procs = [_launch_rank(r, port, extra, env_extra=env_extra,
+                          world_size=world_size,
                           hb_port=hb_port) for r in range(world_size)]
     results = []
     try:
@@ -295,18 +301,28 @@ def test_checkpoint_with_zero1_sharded_state(tmp_path):
 
 def test_four_process_dp_pp(tmp_path):
     """world_size=4: a dp=2 x pp=2 mesh over four OS processes (one CPU
-    device each) completes an epoch with rank-0-only printing."""
+    device each) completes an epoch with rank-0-only printing — and with
+    per-host input sharding: each host materializes only its 1/dp of every
+    batch (rows [0,30) of the 60-row batch on the data-shard-0 hosts,
+    [30,60) on data-shard-1; asserted via the SDML_DEBUG_SHARDING stderr
+    diagnostic, which never touches the reference-format stdout)."""
     rs = run_ranks([
         "--model", "mlp", "--mlp-dims", "784,64,10", "--epochs", "1",
         "--stages", "2", "--dp", "2", "--microbatches", "2",
         "--data-root", str(tmp_path / "nodata"),
-    ], timeout=560, world_size=4)
+    ], timeout=560, world_size=4, env_extra={"SDML_DEBUG_SHARDING": "1"})
     assert rs[0].returncode == 0, f"rank0 failed:\n{rs[0].stderr[-3000:]}"
     for r in rs[1:]:
         assert r.returncode == 0, f"peer failed:\n{r.stderr[-3000:]}"
         assert "Train Epoch" not in r.stdout
     assert "Train Epoch: 1" in rs[0].stdout
     assert "Test set: Average loss:" in rs[0].stdout
+    # device order is data-major: ranks 0,1 = data shard 0, ranks 2,3 =
+    # data shard 1; every host holds exactly half the 60-row global batch
+    for rank, want in [(0, "[0,30) of 60"), (1, "[0,30) of 60"),
+                       (2, "[30,60) of 60"), (3, "[30,60) of 60")]:
+        assert f"| host {rank}: input rows {want}" in rs[rank].stderr, (
+            rank, rs[rank].stderr[-1500:])
 
 
 def test_two_process_launch_1f1b(tmp_path):

@@ -16,6 +16,8 @@ from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
 from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
 
 
+
+pytestmark = pytest.mark.slow  # OS-subprocess / sweep heavy: per-round gate
 def _pipes(dims, n_stages, n_data=1, n_micro=1):
     key = jax.random.key(0)
     stages, wire, out = make_mlp_stages(key, dims, n_stages)

@@ -49,6 +49,7 @@ def _make_problem(key, dims, n_stages, batch):
     (4, 2, 4),   # pipeline + data parallel + GPipe combined
     (1, 1, 2),   # degenerate single-stage (fused) pipeline
 ])
+@pytest.mark.slow            # heavy parity sweep: per-round gate
 def test_pipeline_matches_fused_loss_and_grad(n_stages, n_data, n_micro):
     key = jax.random.key(42)
     dims = [12, 16, 16, 16, 10] if n_stages == 4 else [12, 16, 10]
@@ -86,6 +87,7 @@ def test_pipeline_matches_fused_loss_and_grad(n_stages, n_data, n_micro):
 
 
 @pytest.mark.parametrize("n_micro", [1, 4])
+@pytest.mark.slow
 def test_loss_only_engine_matches_full(n_micro):
     """Pipeline.loss (the training path: no logits accumulator in the scan
     carry) must produce the identical value AND gradient as
@@ -144,6 +146,7 @@ def test_training_trajectory_matches_fused():
     assert pipe_losses[-1] < pipe_losses[0]
 
 
+@pytest.mark.slow
 def test_data_parallel_matches_single_data_rank():
     """Same global batch, dp=4 vs dp=1: identical loss and grads."""
     key = jax.random.key(9)
@@ -197,6 +200,7 @@ def test_dropout_trains_and_eval_is_deterministic():
     np.testing.assert_allclose(float(l1), float(l2))  # eval ignores the key
 
 
+@pytest.mark.slow
 def test_gpipe_replicated_plain_stages_on_sharded_mesh():
     """Plain (unsharded) stages on a model=2 mesh: the switch transpose
     used to reject this with 'mismatched varying manual axes' — the
@@ -231,6 +235,7 @@ def test_gpipe_replicated_plain_stages_on_sharded_mesh():
                                        rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_gpipe_mixed_dense_and_moe_stages_on_expert_mesh():
     """A dense GPT stage and an EP-MoE GPT stage in ONE pipeline on an
     expert=2 mesh — another switch-transpose vma mismatch fixed by the
