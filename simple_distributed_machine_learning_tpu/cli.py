@@ -129,6 +129,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="expert-parallel width for --model=gpt with "
                         "--experts: shards expert weights over an 'expert' "
                         "mesh axis with all-to-all dispatch")
+    g.add_argument('--text-corpus', default=None, metavar="PATH",
+                   help="for --model=gpt: train on the BYTES of this local "
+                        "file (vocab=256, next-byte LM, contiguous "
+                        "train/test split) instead of the synthetic Markov "
+                        "stream — the reference's real-data-first sourcing "
+                        "mapped to a zero-egress environment")
     g.add_argument('--attn', choices=("dense", "flash", "ring", "ulysses"),
                    default="dense",
                    help="attention implementation for --model=gpt (flash = "
@@ -351,16 +357,30 @@ def _run_gpt(args, n_stages: int, key) -> None:
         Trainer,
     )
 
-    cfg = GPTConfig(n_experts=args.experts,
+    cfg = GPTConfig(vocab=256 if args.text_corpus else 128,
+                    n_experts=args.experts,
                     moe_top_k=min(2, max(1, args.experts)),
                     attn_impl=args.attn, n_seq=args.sp,
                     n_expert_parallel=args.ep)
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
-    # one Markov chain, disjoint train/test sequences (a different seed would
-    # regenerate a different transition matrix — nothing would transfer)
-    all_data = synthetic_tokens(7000, cfg.seq_len, cfg.vocab, seed=args.seed)
-    train_ds = Dataset(all_data.x[:6000].astype(np.float32), all_data.y[:6000])
-    test_ds = Dataset(all_data.x[6000:].astype(np.float32), all_data.y[6000:])
+    if args.text_corpus:
+        # real data: next-byte LM over a local file (data/text.py)
+        from simple_distributed_machine_learning_tpu.data.text import (
+            byte_corpus,
+        )
+        tr, te = byte_corpus(args.text_corpus, cfg.seq_len)
+        train_ds = Dataset(tr.x.astype(np.float32), tr.y)
+        test_ds = Dataset(te.x.astype(np.float32), te.y)
+    else:
+        # one Markov chain, disjoint train/test sequences (a different seed
+        # would regenerate a different transition matrix — nothing would
+        # transfer)
+        all_data = synthetic_tokens(7000, cfg.seq_len, cfg.vocab,
+                                    seed=args.seed)
+        train_ds = Dataset(all_data.x[:6000].astype(np.float32),
+                           all_data.y[:6000])
+        test_ds = Dataset(all_data.x[6000:].astype(np.float32),
+                          all_data.y[6000:])
 
     mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_seq=args.sp,
                      n_expert=args.ep)

@@ -36,3 +36,36 @@ def synthetic_tokens(n_seqs: int, seq_len: int, vocab: int,
         toks[:, t + 1] = np.argmax(logp[toks[:, t]] + g, axis=1)
     toks = toks.astype(np.int32)
     return LMData(toks[:, :-1], toks[:, 1:])
+
+
+def byte_corpus(path: str, seq_len: int, test_frac: float = 0.1,
+                max_seqs: int | None = None) -> tuple[LMData, LMData]:
+    """Byte-level LM dataset from a LOCAL file: ``(train, test)``.
+
+    The real-data path for ``--model gpt`` — the LM analogue of the MNIST
+    IDX loader (the reference sources real data first and falls back to
+    synthetic, ``/root/reference/simple_distributed.py:87-95``; zero-egress
+    here means the corpus is any file already on disk). vocab is the full
+    byte range (256). The file is chopped into non-overlapping ``seq_len``
+    windows with next-byte targets (``y[t] = x[t+1]``'s byte); the split is
+    contiguous — the test tail is text the model never trained on.
+    """
+    with open(path, "rb") as f:
+        raw = np.frombuffer(f.read(), np.uint8)
+    n = (len(raw) - 1) // seq_len
+    if n < 2:
+        raise ValueError(
+            f"corpus {path!r} has {len(raw)} bytes — needs at least "
+            f"2*seq_len+1 = {2 * seq_len + 1} for a train/test split")
+    if max_seqs is not None:
+        if max_seqs < 2:
+            raise ValueError(
+                f"max_seqs={max_seqs} leaves nothing to split (need >= 2 "
+                f"windows, one each for train and test)")
+        n = min(n, max_seqs)
+    x = raw[:n * seq_len].reshape(n, seq_len).astype(np.int32)
+    y = raw[1:n * seq_len + 1].reshape(n, seq_len).astype(np.int32)
+    n_test = max(1, int(n * test_frac))
+    n_train = n - n_test
+    return (LMData(x[:n_train], y[:n_train]),
+            LMData(x[n_train:], y[n_train:]))

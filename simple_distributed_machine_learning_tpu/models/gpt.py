@@ -239,7 +239,6 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
     t_loc = cfg.seq_len // cfg.n_seq        # tokens per seq shard
 
     stages: list[Stage] = []
-    start = 0
     for s in range(n_stages):
         stage_blocks = block_split[s]
         first, last = s == 0, s == n_stages - 1
@@ -291,7 +290,6 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
                                 in_shape=in_shape, expert_shards=shards))
         else:
             stages.append(Stage(apply=apply, params=params, in_shape=in_shape))
-        start += len(stage_blocks)
 
     # the wire carries only INTER-stage activations ([t_loc, d_model] blocks
     # and the stage-0 token ids); the last stage's [t_loc, vocab] log-probs

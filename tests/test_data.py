@@ -97,3 +97,28 @@ def test_per_host_sharding_single_process():
     g = make_global_batch(mesh, x, 60)
     assert isinstance(g, jax.Array) and g.shape == (60, 3)
     np.testing.assert_array_equal(np.asarray(g), x)
+
+
+def test_byte_corpus_shapes_and_targets(tmp_path):
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.data.text import byte_corpus
+
+    p = tmp_path / "corpus.bin"
+    p.write_bytes(bytes(range(256)) * 10)        # 2560 bytes
+    tr, te = byte_corpus(str(p), seq_len=32)
+    assert tr.x.shape[1] == te.x.shape[1] == 32
+    assert tr.x.shape[0] + te.x.shape[0] == (2560 - 1) // 32
+    # next-byte contract: y[t] == x[t+1] within a window
+    np.testing.assert_array_equal(tr.y[:, :-1], tr.x[:, 1:])
+    # the split is contiguous: test windows come after every train window
+    raw = np.frombuffer(p.read_bytes(), np.uint8)
+    np.testing.assert_array_equal(
+        te.x[0], raw[tr.x.shape[0] * 32:(tr.x.shape[0] + 1) * 32])
+    assert int(tr.x.max()) < 256 and int(tr.x.min()) >= 0
+
+    import pytest
+    small = tmp_path / "tiny.bin"
+    small.write_bytes(b"xy")
+    with pytest.raises(ValueError, match="needs at least"):
+        byte_corpus(str(small), seq_len=32)

@@ -17,7 +17,12 @@ from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
 
 
 
-pytestmark = pytest.mark.slow  # OS-subprocess / sweep heavy: per-round gate
+# sweep-heavy module: slow-tier (per-round gate). The quick per-commit gate
+# still exercises the 1F1B engine via the parity smoke in
+# tests/test_schedules.py::test_1f1b_quick_parity_smoke.
+pytestmark = pytest.mark.slow
+
+
 def _pipes(dims, n_stages, n_data=1, n_micro=1):
     key = jax.random.key(0)
     stages, wire, out = make_mlp_stages(key, dims, n_stages)

@@ -220,3 +220,36 @@ def test_cli_schedule_and_clip(capsys):
           "--warmup-steps", "5", "--clip-norm", "1.0"])
     out = capsys.readouterr().out
     assert "Test set: Average loss:" in out
+
+
+def test_1f1b_quick_parity_smoke():
+    """Quick-tier coverage of the 1F1B engine (the full sweep lives in the
+    slow-tier tests/test_onefb.py): loss AND grads of the hand-scheduled
+    backward match GPipe on a 2-stage, 2-microbatch pipeline."""
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models.mlp import (
+        make_mlp_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+
+    dims = [12, 16, 10]
+    stages, wire, out = make_mlp_stages(jax.random.key(0), dims, 2)
+    mesh = make_mesh(n_stages=2, n_data=1, devices=jax.devices()[:2])
+    gp = Pipeline(stages, mesh, wire, out, n_microbatches=2)
+    fb = Pipeline(stages, mesh, wire, out, n_microbatches=2,
+                  schedule="1f1b")
+    x = jax.random.normal(jax.random.key(1), (8, 12))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 10)
+    buf = gp.init_params()
+    key = jax.random.key(7)
+    lg, gg = gp.loss_and_grads(buf, x, y, key, deterministic=True)
+    lf, gf = fb.loss_and_grads(buf, x, y, key, deterministic=True)
+    np.testing.assert_allclose(float(lg), float(lf), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gg), np.asarray(gf), rtol=2e-4,
+                               atol=2e-4)
