@@ -363,24 +363,24 @@ def _run_gpt(args, n_stages: int, key) -> None:
                     attn_impl=args.attn, n_seq=args.sp,
                     n_expert_parallel=args.ep)
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
+    def as_ds(x, y):
+        return Dataset(x.astype(np.float32), y)
+
     if args.text_corpus:
         # real data: next-byte LM over a local file (data/text.py)
         from simple_distributed_machine_learning_tpu.data.text import (
             byte_corpus,
         )
         tr, te = byte_corpus(args.text_corpus, cfg.seq_len)
-        train_ds = Dataset(tr.x.astype(np.float32), tr.y)
-        test_ds = Dataset(te.x.astype(np.float32), te.y)
+        train_ds, test_ds = as_ds(*tr), as_ds(*te)
     else:
         # one Markov chain, disjoint train/test sequences (a different seed
         # would regenerate a different transition matrix — nothing would
         # transfer)
         all_data = synthetic_tokens(7000, cfg.seq_len, cfg.vocab,
                                     seed=args.seed)
-        train_ds = Dataset(all_data.x[:6000].astype(np.float32),
-                           all_data.y[:6000])
-        test_ds = Dataset(all_data.x[6000:].astype(np.float32),
-                          all_data.y[6000:])
+        train_ds = as_ds(all_data.x[:6000], all_data.y[:6000])
+        test_ds = as_ds(all_data.x[6000:], all_data.y[6000:])
 
     mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_seq=args.sp,
                      n_expert=args.ep)

@@ -48,24 +48,30 @@ def byte_corpus(path: str, seq_len: int, test_frac: float = 0.1,
     here means the corpus is any file already on disk). vocab is the full
     byte range (256). The file is chopped into non-overlapping ``seq_len``
     windows with next-byte targets (``y[t] = x[t+1]``'s byte); the split is
-    contiguous — the test tail is text the model never trained on.
+    contiguous AND skips the boundary byte — the last train window's final
+    TARGET would otherwise be the first test byte, so test text starts one
+    byte later and is strictly never seen in training (input or target).
     """
     with open(path, "rb") as f:
         raw = np.frombuffer(f.read(), np.uint8)
     n = (len(raw) - 1) // seq_len
-    if n < 2:
-        raise ValueError(
-            f"corpus {path!r} has {len(raw)} bytes — needs at least "
-            f"2*seq_len+1 = {2 * seq_len + 1} for a train/test split")
     if max_seqs is not None:
         if max_seqs < 2:
             raise ValueError(
                 f"max_seqs={max_seqs} leaves nothing to split (need >= 2 "
                 f"windows, one each for train and test)")
         n = min(n, max_seqs)
-    x = raw[:n * seq_len].reshape(n, seq_len).astype(np.int32)
-    y = raw[1:n * seq_len + 1].reshape(n, seq_len).astype(np.int32)
     n_test = max(1, int(n * test_frac))
     n_train = n - n_test
-    return (LMData(x[:n_train], y[:n_train]),
-            LMData(x[n_train:], y[n_train:]))
+    off = n_train * seq_len + 1        # +1: skip the leaked boundary byte
+    n_test = (len(raw) - off - 1) // seq_len if n_train >= 1 else 0
+    if n_train < 1 or n_test < 1:
+        raise ValueError(
+            f"corpus {path!r} has {len(raw)} bytes — needs at least "
+            f"2*seq_len+2 = {2 * seq_len + 2} for a held-out test split")
+    tr_x = raw[:n_train * seq_len].reshape(n_train, seq_len)
+    tr_y = raw[1:n_train * seq_len + 1].reshape(n_train, seq_len)
+    te_x = raw[off:off + n_test * seq_len].reshape(n_test, seq_len)
+    te_y = raw[off + 1:off + n_test * seq_len + 1].reshape(n_test, seq_len)
+    return (LMData(tr_x.astype(np.int32), tr_y.astype(np.int32)),
+            LMData(te_x.astype(np.int32), te_y.astype(np.int32)))

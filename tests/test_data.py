@@ -108,13 +108,16 @@ def test_byte_corpus_shapes_and_targets(tmp_path):
     p.write_bytes(bytes(range(256)) * 10)        # 2560 bytes
     tr, te = byte_corpus(str(p), seq_len=32)
     assert tr.x.shape[1] == te.x.shape[1] == 32
-    assert tr.x.shape[0] + te.x.shape[0] == (2560 - 1) // 32
     # next-byte contract: y[t] == x[t+1] within a window
     np.testing.assert_array_equal(tr.y[:, :-1], tr.x[:, 1:])
-    # the split is contiguous: test windows come after every train window
+    np.testing.assert_array_equal(te.y[:, :-1], te.x[:, 1:])
+    # the test split is contiguous, offset ONE byte past the train tail: the
+    # last train target (raw[n_train*T]) must never appear in the test text
     raw = np.frombuffer(p.read_bytes(), np.uint8)
-    np.testing.assert_array_equal(
-        te.x[0], raw[tr.x.shape[0] * 32:(tr.x.shape[0] + 1) * 32])
+    n_train = tr.x.shape[0]
+    boundary = n_train * 32
+    assert int(tr.y[-1, -1]) == int(raw[boundary])
+    np.testing.assert_array_equal(te.x[0], raw[boundary + 1:boundary + 33])
     assert int(tr.x.max()) < 256 and int(tr.x.min()) >= 0
 
     import pytest
@@ -122,3 +125,9 @@ def test_byte_corpus_shapes_and_targets(tmp_path):
     small.write_bytes(b"xy")
     with pytest.raises(ValueError, match="needs at least"):
         byte_corpus(str(small), seq_len=32)
+    # exactly 2T+1 bytes: enough for two windows but not for the held-out
+    # skip — must still refuse rather than silently leak
+    edge = tmp_path / "edge.bin"
+    edge.write_bytes(bytes(65))
+    with pytest.raises(ValueError, match="needs at least"):
+        byte_corpus(str(edge), seq_len=32)
