@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# One-command TPU measurement sweep for a freshly healed axon tunnel.
+#
+# Discipline (see BASELINE.md incident notes): the tunnel serves ONE client
+# at a time and a killed/overlapping client can wedge the server-side claim
+# for hours. So: bounded smoke probe first, STRICTLY sequential clients,
+# a settle pause between client exits, and never kill a client mid-dispatch
+# (timeouts here are generous on purpose).
+#
+# Artifacts refreshed on success:
+#   benchmarks/flash_timing.json   (dtype-fixed fwd+bwd kernels, dh=128/T=8192)
+#   benchmarks/results_all.json    (all configs incl. AdamW bf16 rows + decode)
+#   benchmarks/decode_timing.json  (KV-cache vs recompute tokens/sec)
+#   flash_tune output              (benchmarks/flash_tune.log, block sweep)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python -c \
+    "import jax, jax.numpy as jnp; print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))" \
+    >/dev/null 2>&1
+}
+
+echo "[sweep] probing tunnel..."
+if ! probe; then
+  echo "[sweep] tunnel wedged (probe timed out) - aborting before any client"
+  exit 17
+fi
+sleep 10
+
+echo "[sweep] 1/4 flash_timing (fwd+bwd, incl. dh=128 and T=8192 rows)"
+timeout 2400 python benchmarks/flash_timing.py || echo "[sweep] flash_timing rc=$?"
+sleep 15
+
+echo "[sweep] 2/4 bench --all (all configs + decode row)"
+timeout 3000 python bench.py --all || echo "[sweep] bench --all rc=$?"
+sleep 15
+
+echo "[sweep] 3/4 bench --config gpt_bf16_xl (MXU-stretch MFU row)"
+timeout 1800 python bench.py --config gpt_bf16_xl || echo "[sweep] xl rc=$?"
+sleep 15
+
+echo "[sweep] 4/4 flash_tune block sweep (log: benchmarks/flash_tune.log)"
+timeout 3000 python benchmarks/flash_tune.py | tee benchmarks/flash_tune.log \
+  || echo "[sweep] flash_tune rc=$?"
+
+echo "[sweep] done"

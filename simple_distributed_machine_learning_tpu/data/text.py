@@ -64,7 +64,10 @@ def byte_corpus(path: str, seq_len: int, test_frac: float = 0.1,
     n_test = max(1, int(n * test_frac))
     n_train = n - n_test
     off = n_train * seq_len + 1        # +1: skip the leaked boundary byte
-    n_test = (len(raw) - off - 1) // seq_len if n_train >= 1 else 0
+    # the skip can cost the last window a byte; recompute what still fits,
+    # but never grow past the test_frac/max_seqs-derived count
+    n_test = (min((len(raw) - off - 1) // seq_len, n_test)
+              if n_train >= 1 else 0)
     if n_train < 1 or n_test < 1:
         raise ValueError(
             f"corpus {path!r} has {len(raw)} bytes — needs at least "

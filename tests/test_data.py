@@ -131,3 +131,16 @@ def test_byte_corpus_shapes_and_targets(tmp_path):
     edge.write_bytes(bytes(65))
     with pytest.raises(ValueError, match="needs at least"):
         byte_corpus(str(edge), seq_len=32)
+
+
+def test_byte_corpus_max_seqs_caps_both_splits(tmp_path):
+    import pytest
+
+    from simple_distributed_machine_learning_tpu.data.text import byte_corpus
+
+    p = tmp_path / "big.bin"
+    p.write_bytes(bytes(range(256)) * 100)       # 25600 bytes
+    tr, te = byte_corpus(str(p), seq_len=32, max_seqs=4)
+    assert tr.x.shape[0] == 3 and te.x.shape[0] == 1
+    with pytest.raises(ValueError, match="max_seqs"):
+        byte_corpus(str(p), seq_len=32, max_seqs=1)

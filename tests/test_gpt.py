@@ -235,3 +235,25 @@ def test_cached_decoder_validation():
     moe_stages, _, _ = make_gpt_stages(jax.random.key(0), moe, n_stages=1)
     with pytest.raises(ValueError, match="dense-MLP blocks only"):
         make_cached_decoder(moe_stages, moe, 4, 4)
+
+
+def test_cached_decoder_sampling_matches_recompute():
+    """temperature > 0: both decoders split the PRNG key once per generated
+    token in the same order, so sampled tokens are IDENTICAL too — pins the
+    key-stream contract, not just the greedy path."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_cached_decoder,
+        make_decoder,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=24, d_model=32, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, 2)
+    params = [s.params for s in stages]
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 0, cfg.vocab)
+    want = make_decoder(stages, 5, 9, temperature=1.0)(
+        params, prompt, jax.random.key(7))
+    got = make_cached_decoder(stages, cfg, 5, 9, temperature=1.0)(
+        params, prompt, jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
