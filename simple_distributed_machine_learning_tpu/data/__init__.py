@@ -1,1 +1,11 @@
-"""Data pipeline: MNIST (IDX files or deterministic synthetic fallback)."""
+"""Data pipeline: MNIST (IDX files or synthetic fallback), byte-LM corpora,
+per-host batch sharding."""
+
+from simple_distributed_machine_learning_tpu.data.sharding import (  # noqa: F401
+    host_rows,
+    make_global_batch,
+)
+from simple_distributed_machine_learning_tpu.data.text import (  # noqa: F401
+    byte_corpus,
+    synthetic_tokens,
+)

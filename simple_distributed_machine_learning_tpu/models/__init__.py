@@ -8,6 +8,7 @@ microbatching.
 from simple_distributed_machine_learning_tpu.models.gpt import (  # noqa: F401
     GPTConfig,
     generate,
+    make_cached_decoder,
     make_decoder,
     make_gpt_stages,
 )

@@ -333,6 +333,57 @@ def _grad_sync_non_expert(params: dict) -> dict:
         params)
 
 
+def _filter_top(scaled: jax.Array, top_k: int | None,
+                top_p: float | None) -> jax.Array:
+    """Top-k / nucleus filtering on temperature-scaled log-probs [B, V].
+
+    Masked tokens get -inf (zero probability under categorical). Applied
+    after temperature scaling, top-k before top-p — the standard sampling
+    pipeline. The top-1 token is always kept (top_p exclusive-cumsum rule),
+    so the distribution can never become empty.
+    """
+    if top_k is not None and top_k > scaled.shape[-1]:
+        raise ValueError(
+            f"top_k={top_k} exceeds the row width {scaled.shape[-1]} "
+            f"(the model's vocab)")
+    if top_k is not None:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]       # [B, 1]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None:
+        srt = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)    # descending
+        p = jax.nn.softmax(srt, axis=-1)
+        exclusive = jnp.cumsum(p, axis=-1) - p
+        keep = exclusive < top_p                               # top-1 always
+        thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
+    return scaled
+
+
+def _sample_row(row, k, temperature, top_k, top_p):
+    """One decode step on [B, V] log-probs -> ``(tokens, next_key)``.
+
+    The ONE copy of the scale/split/filter/categorical pipeline — both
+    decoders call it, which is what keeps their key streams (and therefore
+    their sampled tokens) exactly identical."""
+    if temperature > 0.0:
+        k, ks = jax.random.split(k)
+        scaled = _filter_top(row / temperature, top_k, top_p)
+        return jax.random.categorical(ks, scaled, axis=-1), k
+    return jnp.argmax(row, axis=-1), k
+
+
+def _check_sampling_args(temperature, top_k, top_p, vocab=None):
+    if (top_k is not None or top_p is not None) and temperature <= 0.0:
+        raise ValueError("top_k/top_p filtering needs temperature > 0 "
+                         "(greedy decoding ignores the filtered tail)")
+    if top_k is not None and (top_k < 1 or
+                              (vocab is not None and top_k > vocab)):
+        raise ValueError(f"top_k={top_k} out of range [1, vocab={vocab}]")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} out of range (0, 1]")
+
+
 def generate(stages, prompt: jax.Array, n_new: int,
              key: jax.Array | None = None,
              temperature: float = 0.0) -> jax.Array:
@@ -364,7 +415,8 @@ def generate(stages, prompt: jax.Array, n_new: int,
 
 
 def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
-                        temperature: float = 0.0):
+                        temperature: float = 0.0, top_k: int | None = None,
+                        top_p: float | None = None):
     """KV-cache decode: ``decode(params, prompt, key) -> [B, prompt_len+n_new]``.
 
     Same contract as :func:`make_decoder` but O(T) per generated token instead
@@ -412,6 +464,7 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
     if n_new < 1:
         raise ValueError("make_cached_decoder needs n_new >= 1 (there is "
                          "nothing to cache for a pure-prefill call)")
+    _check_sampling_args(temperature, top_k, top_p, cfg.vocab)
     total = prompt_len + n_new
     if total > cfg.seq_len:
         raise ValueError(
@@ -448,10 +501,7 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
                                   layer_norm(head["ln_f"], h_last)))
 
     def _pick(row, k):
-        if temperature > 0.0:
-            k, ks = jax.random.split(k)
-            return jax.random.categorical(ks, row / temperature, axis=-1), k
-        return jnp.argmax(row, axis=-1), k
+        return _sample_row(row, k, temperature, top_k, top_p)
 
     def _qkv(bp, h):
         """ln1 + QKV projections — shared by prefill and decode step so the
@@ -528,7 +578,8 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
 
 
 def make_decoder(stages, prompt_len: int, n_new: int,
-                 temperature: float = 0.0):
+                 temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None):
     """Build the jitted decode fn: ``decode(params, prompt, key) ->
     [B, prompt_len + n_new]`` tokens.
 
@@ -548,6 +599,9 @@ def make_decoder(stages, prompt_len: int, n_new: int,
         raise ValueError(
             "generate needs a non-empty prompt (t0 >= 1): the first decoded "
             "token is conditioned on the prompt's last position")
+    # vocab-bound validation of top_k happens at trace time in _filter_top
+    # against the actual row width — no reach into the param layout here
+    _check_sampling_args(temperature, top_k, top_p)
     # the stages are traced at a fixed sequence length (stage 0's in_shape);
     # decode inside that static buffer
     seq_len = int(stages[0].in_shape[0])
@@ -569,11 +623,7 @@ def make_decoder(stages, prompt_len: int, n_new: int,
             logp = fused(params, buf.astype(jnp.float32), k, True)
             # prediction for position i comes from the read at i-1
             row = lax.dynamic_index_in_dim(logp, i - 1, 1, keepdims=False)
-            if temperature > 0.0:
-                k, ks = jax.random.split(k)
-                tok = jax.random.categorical(ks, row / temperature, axis=-1)
-            else:
-                tok = jnp.argmax(row, axis=-1)
+            tok, k = _sample_row(row, k, temperature, top_k, top_p)
             buf = lax.dynamic_update_slice_in_dim(
                 buf, tok[:, None].astype(jnp.int32), i, 1)
             return (buf, k), None

@@ -11,6 +11,12 @@ from simple_distributed_machine_learning_tpu.train.step import (  # noqa: F401
     make_scanned_train_step,
     make_train_step,
 )
+from simple_distributed_machine_learning_tpu.train.checkpoint import (  # noqa: F401
+    repack_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+    save_checkpoint_async,
+)
 from simple_distributed_machine_learning_tpu.train.trainer import (  # noqa: F401
     TrainConfig,
     Trainer,

@@ -257,3 +257,56 @@ def test_cached_decoder_sampling_matches_recompute():
     got = make_cached_decoder(stages, cfg, 5, 9, temperature=1.0)(
         params, prompt, jax.random.key(7))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_top_k_top_p_sampling():
+    """Top-k / nucleus filtering: cross-decoder parity, support restriction
+    (every sampled token lies in the allowed set), and validation."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_cached_decoder,
+        make_decoder,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        fused_reference,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=24, d_model=32, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, 1)
+    params = [s.params for s in stages]
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, cfg.vocab)
+
+    # cross-decoder parity: same key stream -> identical filtered samples
+    for kw in [dict(top_k=3), dict(top_p=0.5), dict(top_k=5, top_p=0.9)]:
+        want = make_decoder(stages, 4, 8, temperature=0.8, **kw)(
+            params, prompt, jax.random.key(9))
+        got = make_cached_decoder(stages, cfg, 4, 8, temperature=0.8, **kw)(
+            params, prompt, jax.random.key(9))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # support restriction: with top_k=3, every generated token must be among
+    # that step's 3 highest-probability tokens (check step 1 over many seeds)
+    fused = fused_reference(stages)
+    logp = fused(params, jnp.pad(prompt, ((0, 0), (0, 20))).astype(
+        jnp.float32), jax.random.key(0), True)
+    allowed = np.asarray(jax.lax.top_k(logp[:, 3], 3)[1])      # [2, 3]
+    dec = make_cached_decoder(stages, cfg, 4, 1, temperature=1.0, top_k=3)
+    for seed in range(20):
+        out = np.asarray(dec(params, prompt, jax.random.key(seed)))
+        for b in range(2):
+            assert out[b, 4] in allowed[b], (seed, out[b, 4], allowed[b])
+
+    # top_k=1 at any temperature is greedy
+    greedy = make_cached_decoder(stages, cfg, 4, 8)(
+        params, prompt, jax.random.key(0))
+    k1 = make_cached_decoder(stages, cfg, 4, 8, temperature=2.0, top_k=1)(
+        params, prompt, jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    with pytest.raises(ValueError, match="temperature > 0"):
+        make_cached_decoder(stages, cfg, 4, 4, top_k=3)
+    with pytest.raises(ValueError, match="top_p"):
+        make_cached_decoder(stages, cfg, 4, 4, temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="top_k"):
+        make_decoder(stages, 4, 4, temperature=1.0, top_k=0)
