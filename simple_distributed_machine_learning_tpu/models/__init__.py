@@ -7,6 +7,7 @@ microbatching.
 
 from simple_distributed_machine_learning_tpu.models.gpt import (  # noqa: F401
     GPTConfig,
+    decoder_from_pipeline,
     generate,
     make_cached_decoder,
     make_decoder,
@@ -14,5 +15,8 @@ from simple_distributed_machine_learning_tpu.models.gpt import (  # noqa: F401
 )
 from simple_distributed_machine_learning_tpu.models.lenet import (  # noqa: F401
     make_lenet_stages,
+)
+from simple_distributed_machine_learning_tpu.models.pp_decode import (  # noqa: F401
+    make_pp_decoder,
 )
 from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages  # noqa: F401

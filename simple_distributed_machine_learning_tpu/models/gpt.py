@@ -360,16 +360,27 @@ def _filter_top(scaled: jax.Array, top_k: int | None,
     return scaled
 
 
+def _sample_from(row, ks, temperature, top_k, top_p):
+    """Scale/filter/categorical core on a PRE-SPLIT subkey ``ks`` (argmax
+    when temperature == 0) — the ONE copy of the sampling math, shared by
+    every decoder (cached, recompute, pipeline-parallel)."""
+    if temperature > 0.0:
+        return jax.random.categorical(
+            ks, _filter_top(row / temperature, top_k, top_p), axis=-1)
+    return jnp.argmax(row, axis=-1)
+
+
 def _sample_row(row, k, temperature, top_k, top_p):
     """One decode step on [B, V] log-probs -> ``(tokens, next_key)``.
 
-    The ONE copy of the scale/split/filter/categorical pipeline — both
-    decoders call it, which is what keeps their key streams (and therefore
-    their sampled tokens) exactly identical."""
+    The ONE copy of the split discipline (exactly one split per sampled
+    token) over :func:`_sample_from` — the single-device decoders call it,
+    which is what keeps their key streams (and therefore their sampled
+    tokens) exactly identical; the pipeline decoder performs the same split
+    itself (uniformly on every device) and calls :func:`_sample_from`."""
     if temperature > 0.0:
         k, ks = jax.random.split(k)
-        scaled = _filter_top(row / temperature, top_k, top_p)
-        return jax.random.categorical(ks, scaled, axis=-1), k
+        return _sample_from(row, ks, temperature, top_k, top_p), k
     return jnp.argmax(row, axis=-1), k
 
 
@@ -573,6 +584,35 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
              jnp.moveaxis(toks, 0, 1),
              last[:, None]], axis=1)
         return out
+
+    return decode
+
+
+def decoder_from_pipeline(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
+                          temperature: float = 0.0, top_k: int | None = None,
+                          top_p: float | None = None):
+    """Cached decode bound to a training :class:`~..parallel.pipeline.Pipeline`:
+    returns ``decode(buf, prompt, key)`` taking the LIVE packed param buffer.
+
+    The bridge from training to inference: no manual unpacking, no separate
+    weight copy — checkpoint-restore or train, then decode from the same
+    buffer. The buffer is gathered to host and re-split into stage trees per
+    call (``Pipeline.unpack``), then the single-device KV-cache decoder runs
+    on them; for a training run that decodes once per eval epoch this
+    host-side gather is noise. Tensor-/expert-sharded stages are rejected
+    (their trees are per-shard slices, not the whole model).
+    """
+    if any(s.shards is not None or s.expert_shards is not None
+           for s in pipe.stages):
+        raise ValueError(
+            "decoder_from_pipeline needs unsharded stage params — gather "
+            "tensor/expert shards into a dense build first")
+    dec = make_cached_decoder(pipe.stages, cfg, prompt_len, n_new,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p)
+
+    def decode(buf, prompt, key):
+        return dec(pipe.unpack(buf), prompt, key)
 
     return decode
 

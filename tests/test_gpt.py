@@ -310,3 +310,40 @@ def test_top_k_top_p_sampling():
         make_cached_decoder(stages, cfg, 4, 4, temperature=1.0, top_p=1.5)
     with pytest.raises(ValueError, match="top_k"):
         make_decoder(stages, 4, 4, temperature=1.0, top_k=0)
+
+
+def test_decoder_from_pipeline_uses_live_buffer():
+    """Decode straight from the training Pipeline's packed buffer: training
+    for a few steps CHANGES the decoded continuation (the decoder reads the
+    live weights, not a stale copy), and the output matches unpacking the
+    buffer manually."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        decoder_from_pipeline,
+        make_cached_decoder,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2)
+    stages, wd, osh = make_gpt_stages(jax.random.key(0), cfg, 2)
+    mesh = make_mesh(n_stages=2, n_data=1, devices=jax.devices()[:2])
+    pipe = Pipeline(stages, mesh, wd, osh, n_microbatches=1)
+    buf = pipe.init_params()
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, cfg.vocab)
+    dec = decoder_from_pipeline(pipe, cfg, 4, 8)
+
+    out0 = np.asarray(dec(buf, prompt, jax.random.key(0)))
+    want = make_cached_decoder(stages, cfg, 4, 8)(
+        pipe.unpack(buf), prompt, jax.random.key(0))
+    np.testing.assert_array_equal(out0, np.asarray(want))
+
+    data = synthetic_tokens(8, cfg.seq_len, cfg.vocab, seed=2)
+    opt = sgd(0.5, momentum=0.9)
+    state = opt.init(buf)
+    step = make_train_step(pipe, opt)
+    for i in range(10):
+        buf, state, _ = step(buf, state,
+                             jnp.asarray(data.x, jnp.float32),
+                             jnp.asarray(data.y), jax.random.key(i))
+    out1 = np.asarray(dec(buf, prompt, jax.random.key(0)))
+    assert not np.array_equal(out0, out1), "decode ignored training updates"
