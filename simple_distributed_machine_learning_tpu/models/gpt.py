@@ -360,6 +360,84 @@ def _filter_top(scaled: jax.Array, top_k: int | None,
     return scaled
 
 
+def _dense_qkv(bp, h, n_heads):
+    """ln1 + QKV projections of one dense block — the ONE copy shared by the
+    cached and pipeline-parallel decoders (prefill and step), so their math
+    can never drift apart."""
+    hn = layer_norm(bp["ln1"], h)
+    return (_split_heads(hn @ bp["attn"]["wq"], n_heads),
+            _split_heads(hn @ bp["attn"]["wk"], n_heads),
+            _split_heads(hn @ bp["attn"]["wv"], n_heads))
+
+
+def _dense_attn_tail(bp, h, a):
+    """wo merge + residual + ln2 + MLP + residual (the dense block tail)."""
+    h = h + _merge_heads(a) @ bp["attn"]["wo"]
+    hn2 = layer_norm(bp["ln2"], h)
+    return h + linear(bp["mlp_out"], jax.nn.gelu(linear(bp["mlp_in"], hn2)))
+
+
+def _dense_block_prefill(bp, h, li, kc, vc, prompt_len, n_heads):
+    """One block over the whole prompt [b, T0, d], recording cache row
+    ``li`` for positions [0, prompt_len)."""
+    q, k, v = _dense_qkv(bp, h, n_heads)
+    kc = kc.at[li, :, :, :prompt_len].set(k)
+    vc = vc.at[li, :, :, :prompt_len].set(v)
+    return _dense_attn_tail(bp, h, causal_attention_core(q, k, v)), kc, vc
+
+
+def _dense_block_step(bp, h, li, kc, vc, i, total, n_heads):
+    """One block on ONE token [b, 1, d] against cache row ``li``; writes K/V
+    at position ``i``. Same scale expression as causal_attention_core
+    (divide by sqrt(dh)) so prefill and step compile to identical math."""
+    import math
+
+    dh = h.shape[-1] // n_heads
+    q, knew, vnew = _dense_qkv(bp, h, n_heads)          # [B,H,1,dh] each
+    kc = jax.lax.dynamic_update_slice(kc, knew[None], (li, 0, 0, i, 0))
+    vc = jax.lax.dynamic_update_slice(vc, vnew[None], (li, 0, 0, i, 0))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc[li]) / math.sqrt(dh)
+    live = (jnp.arange(total) <= i)[None, None, None, :]
+    scores = jnp.where(live, scores, -jnp.inf)
+    a = jnp.einsum("bhqk,bhkd->bhqd",
+                   jax.nn.softmax(scores, axis=-1), vc[li])
+    return _dense_attn_tail(bp, h, a), kc, vc
+
+
+def _validate_decode_build(stages, cfg, prompt_len, n_new, caller):
+    """Shared decoder-build validation (cached + pipeline-parallel): dense
+    blocks only, sane lengths, and cfg matching the stages' ACTUAL build
+    shapes (a mismatched cfg would otherwise silently clamp pos-table
+    slices past the real seq_len instead of raising)."""
+    if cfg.n_experts > 0:
+        raise ValueError(
+            f"{caller} supports dense-MLP blocks only — MoE capacity is a "
+            f"full-sequence quantity, so per-token cached routing would "
+            f"change overflow behavior; use make_decoder")
+    if prompt_len < 1:
+        raise ValueError(
+            f"{caller} needs a non-empty prompt (t0 >= 1): the first "
+            f"decoded token is conditioned on the prompt's last position")
+    if n_new < 1:
+        raise ValueError(f"{caller} needs n_new >= 1 (there is nothing to "
+                         f"cache for a pure-prefill call)")
+    total = prompt_len + n_new
+    if total > cfg.seq_len:
+        raise ValueError(
+            f"prompt {prompt_len} + n_new {n_new} exceeds the model's "
+            f"sequence length {cfg.seq_len}")
+    embed = next((s.params.get("embed") for s in stages
+                  if isinstance(s.params, dict) and "embed" in s.params),
+                 None)
+    if embed is None or embed["pos"].shape != (cfg.seq_len, cfg.d_model):
+        got = None if embed is None else embed["pos"].shape
+        raise ValueError(
+            f"cfg (seq_len={cfg.seq_len}, d_model={cfg.d_model}) does not "
+            f"match the stages' embedding table {got} — pass the GPTConfig "
+            f"the stages were built with")
+    return total
+
+
 def _sample_from(row, ks, temperature, top_k, top_p):
     """Scale/filter/categorical core on a PRE-SPLIT subkey ``ks`` (argmax
     when temperature == 0) — the ONE copy of the sampling math, shared by
@@ -397,7 +475,10 @@ def _check_sampling_args(temperature, top_k, top_p, vocab=None):
 
 def generate(stages, prompt: jax.Array, n_new: int,
              key: jax.Array | None = None,
-             temperature: float = 0.0) -> jax.Array:
+             temperature: float = 0.0,
+             cfg: GPTConfig | None = None,
+             top_k: int | None = None,
+             top_p: float | None = None) -> jax.Array:
     """Autoregressive decoding from the (single-device) stage composition.
 
     ``prompt``: [B, T0] int tokens; returns [B, T0 + n_new]. The whole decode
@@ -410,8 +491,15 @@ def generate(stages, prompt: jax.Array, n_new: int,
     path is the standard next optimization.
 
     ``temperature=0`` → greedy argmax; ``> 0`` → softmax sampling with
-    ``key`` (required). One-shot convenience: retraces per call — build the
-    decoder once with :func:`make_decoder` for repeated generation.
+    ``key`` (required); ``top_k``/``top_p`` filter the sampling
+    distribution. One-shot convenience: retraces per call — build the
+    decoder once with :func:`make_decoder` / :func:`make_cached_decoder`
+    for repeated generation.
+
+    ``cfg``: pass the stages' build config to decode through the O(T)
+    KV-cache path (:func:`make_cached_decoder`) instead of the O(T²)
+    full-prefix recompute — same tokens, faster; dense-MLP single-device
+    builds only (the cached path's restrictions apply).
 
     The reference has no inference path at all (eval only,
     ``/root/reference/simple_distributed.py:119-132``); this is a capability
@@ -420,8 +508,13 @@ def generate(stages, prompt: jax.Array, n_new: int,
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     key = key if key is not None else jax.random.key(0)
-    dec = make_decoder(stages, int(prompt.shape[1]), n_new,
-                       temperature=temperature)
+    if cfg is not None:
+        dec = make_cached_decoder(stages, cfg, int(prompt.shape[1]), n_new,
+                                  temperature=temperature, top_k=top_k,
+                                  top_p=top_p)
+    else:
+        dec = make_decoder(stages, int(prompt.shape[1]), n_new,
+                           temperature=temperature, top_k=top_k, top_p=top_p)
     return dec([s.params for s in stages], prompt, key)
 
 
@@ -459,42 +552,15 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
     """
     from jax import lax
 
-    if cfg.n_experts > 0:
-        raise ValueError(
-            "make_cached_decoder supports dense-MLP blocks only — MoE "
-            "capacity is a full-sequence quantity, so per-token cached "
-            "routing would change overflow behavior; use make_decoder")
     if cfg.n_seq > 1:
         raise ValueError(
             "cached decode is single-device; rebuild the stages with n_seq=1 "
             "(same weights) as make_decoder requires too")
-    if prompt_len < 1:
-        raise ValueError(
-            "make_cached_decoder needs a non-empty prompt (t0 >= 1): the "
-            "first decoded token is conditioned on the prompt's last position")
-    if n_new < 1:
-        raise ValueError("make_cached_decoder needs n_new >= 1 (there is "
-                         "nothing to cache for a pure-prefill call)")
     _check_sampling_args(temperature, top_k, top_p, cfg.vocab)
-    total = prompt_len + n_new
-    if total > cfg.seq_len:
-        raise ValueError(
-            f"prompt {prompt_len} + n_new {n_new} exceeds the model's "
-            f"sequence length {cfg.seq_len}")
-    import math
-
+    total = _validate_decode_build(stages, cfg, prompt_len, n_new,
+                                   "make_cached_decoder")
     H, d = cfg.n_heads, cfg.d_model
     dh = d // H
-    # validate cfg against the stages' ACTUAL build shapes — a mismatched cfg
-    # would otherwise fail silently (JAX clamps an out-of-range pos-table
-    # dynamic_slice instead of raising, so decode would quietly reuse the
-    # last positional embedding past the real seq_len)
-    pos = stages[0].params["embed"]["pos"]
-    if pos.shape != (cfg.seq_len, cfg.d_model):
-        raise ValueError(
-            f"cfg (seq_len={cfg.seq_len}, d_model={cfg.d_model}) does not "
-            f"match the stages' embedding table {pos.shape} — pass the "
-            f"GPTConfig the stages were built with")
 
     def _merged(params_list):
         """Re-join the per-stage trees into (embed, blocks, head)."""
@@ -514,20 +580,6 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
     def _pick(row, k):
         return _sample_row(row, k, temperature, top_k, top_p)
 
-    def _qkv(bp, h):
-        """ln1 + QKV projections — shared by prefill and decode step so the
-        two paths stay provably identical."""
-        hn = layer_norm(bp["ln1"], h)
-        return (_split_heads(hn @ bp["attn"]["wq"], H),
-                _split_heads(hn @ bp["attn"]["wk"], H),
-                _split_heads(hn @ bp["attn"]["wv"], H))
-
-    def _attn_tail(bp, h, a):
-        """wo merge + residual + ln2 + MLP + residual (the dense block tail)."""
-        h = h + _merge_heads(a) @ bp["attn"]["wo"]
-        hn2 = layer_norm(bp["ln2"], h)
-        return h + linear(bp["mlp_out"], jax.nn.gelu(linear(bp["mlp_in"], hn2)))
-
     @jax.jit
     def decode(params, prompt, key):
         embed, blocks, head = _merged(params)
@@ -541,10 +593,7 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
         ids = prompt.astype(jnp.int32)
         h = embedding_lookup(embed["tok"], ids) + embed["pos"][:prompt_len]
         for li, bp in enumerate(blocks):
-            q, k, v = _qkv(bp, h)
-            kc = kc.at[li, :, :, :prompt_len].set(k)
-            vc = vc.at[li, :, :, :prompt_len].set(v)
-            h = _attn_tail(bp, h, causal_attention_core(q, k, v))
+            h, kc, vc = _dense_block_prefill(bp, h, li, kc, vc, prompt_len, H)
         row = _head_row(head, h[:, -1])
         tok, key = _pick(row, key)          # token for position prompt_len
 
@@ -556,20 +605,7 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
             pos = lax.dynamic_slice_in_dim(embed["pos"], i, 1, 0)
             h = embedding_lookup(embed["tok"], tok[:, None]) + pos   # [B,1,d]
             for li, bp in enumerate(blocks):
-                q, knew, vnew = _qkv(bp, h)                   # [B,H,1,dh] each
-                kc = lax.dynamic_update_slice(kc, knew[None],
-                                              (li, 0, 0, i, 0))
-                vc = lax.dynamic_update_slice(vc, vnew[None],
-                                              (li, 0, 0, i, 0))
-                # same scale expression as causal_attention_core (divide by
-                # sqrt(dh)) so prefill and step compile to identical math
-                scores = (jnp.einsum("bhqd,bhkd->bhqk", q, kc[li])
-                          / math.sqrt(dh))
-                live = (jnp.arange(total) <= i)[None, None, None, :]
-                scores = jnp.where(live, scores, -jnp.inf)
-                a = jnp.einsum("bhqk,bhkd->bhqd",
-                               jax.nn.softmax(scores, axis=-1), vc[li])
-                h = _attn_tail(bp, h, a)
+                h, kc, vc = _dense_block_step(bp, h, li, kc, vc, i, total, H)
             row = _head_row(head, h[:, 0])
             nxt, k = _pick(row, k)
             return (kc, vc, nxt, k), tok

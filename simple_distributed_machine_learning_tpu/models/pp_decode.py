@@ -28,8 +28,6 @@ path at all (``/root/reference/simple_distributed.py:119-132`` is eval-only).
 
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -38,12 +36,10 @@ from jax.sharding import PartitionSpec as P
 from simple_distributed_machine_learning_tpu.models.gpt import (
     GPTConfig,
     _check_sampling_args,
+    _dense_block_prefill,
+    _dense_block_step,
     _sample_from,
-)
-from simple_distributed_machine_learning_tpu.ops.attention import (
-    _merge_heads,
-    _split_heads,
-    causal_attention_core,
+    _validate_decode_build,
 )
 from simple_distributed_machine_learning_tpu.ops.layers import (
     embedding_lookup,
@@ -76,20 +72,9 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
         raise ValueError(
             "make_pp_decoder shards over stage (x data) only — rebuild "
             "without seq/model/expert axes for decoding")
-    if cfg.n_experts > 0:
-        raise ValueError(
-            "make_pp_decoder supports dense-MLP blocks only (MoE capacity "
-            "is a full-sequence quantity; see make_cached_decoder)")
-    if prompt_len < 1:
-        raise ValueError("make_pp_decoder needs a non-empty prompt")
-    if n_new < 1:
-        raise ValueError("make_pp_decoder needs n_new >= 1")
     _check_sampling_args(temperature, top_k, top_p, cfg.vocab)
-    total = prompt_len + n_new
-    if total > cfg.seq_len:
-        raise ValueError(
-            f"prompt {prompt_len} + n_new {n_new} exceeds the model's "
-            f"sequence length {cfg.seq_len}")
+    total = _validate_decode_build(pipe.stages, cfg, prompt_len, n_new,
+                                   "make_pp_decoder")
 
     S = pipe.n_stages
     metas = list(pipe.metas)
@@ -104,53 +89,9 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
     if not (has_embed[0] and has_head[-1]):
         raise ValueError("stage 0 must own 'embed' and the last stage "
                          "'head' (the make_gpt_stages layout)")
-    # validate cfg against the stages' ACTUAL build shapes (same hazard as
-    # make_cached_decoder: a mismatched cfg would silently clamp pos-table
-    # slices past the real seq_len instead of raising)
-    pos = pipe.stages[0].params["embed"]["pos"]
-    if pos.shape != (cfg.seq_len, cfg.d_model):
-        raise ValueError(
-            f"cfg (seq_len={cfg.seq_len}, d_model={cfg.d_model}) does not "
-            f"match the stages' embedding table {pos.shape} — pass the "
-            f"GPTConfig the stages were built with")
     # the packed row is typed varying over stage AND the (size-1) model/
     # expert axes its sharding names — the anchors must match that type
     vary = (DATA_AXIS, STAGE_AXIS, MODEL_AXIS, EXPERT_AXIS)
-
-    def _block_step(bp, h, li, kc, vc, i):
-        """One block on ONE token [b, 1, d] against this stage's cache row
-        ``li``; writes K/V at position ``i``. Same math as
-        make_cached_decoder's step (divide-by-sqrt scale)."""
-        hn = layer_norm(bp["ln1"], h)
-        q = _split_heads(hn @ bp["attn"]["wq"], H)
-        knew = _split_heads(hn @ bp["attn"]["wk"], H)
-        vnew = _split_heads(hn @ bp["attn"]["wv"], H)
-        kc = lax.dynamic_update_slice(kc, knew[None], (li, 0, 0, i, 0))
-        vc = lax.dynamic_update_slice(vc, vnew[None], (li, 0, 0, i, 0))
-        scores = (jnp.einsum("bhqd,bhkd->bhqk", q, kc[li])
-                  / math.sqrt(dh))
-        live = (jnp.arange(total) <= i)[None, None, None, :]
-        scores = jnp.where(live, scores, -jnp.inf)
-        a = jnp.einsum("bhqk,bhkd->bhqd",
-                       jax.nn.softmax(scores, axis=-1), vc[li])
-        h = h + _merge_heads(a) @ bp["attn"]["wo"]
-        hn2 = layer_norm(bp["ln2"], h)
-        h = h + linear(bp["mlp_out"], jax.nn.gelu(linear(bp["mlp_in"], hn2)))
-        return h, kc, vc
-
-    def _block_prefill(bp, h, li, kc, vc):
-        """One block over the whole prompt [b, T0, d], recording its cache
-        rows (the make_cached_decoder prefill math)."""
-        hn = layer_norm(bp["ln1"], h)
-        q = _split_heads(hn @ bp["attn"]["wq"], H)
-        k = _split_heads(hn @ bp["attn"]["wk"], H)
-        v = _split_heads(hn @ bp["attn"]["wv"], H)
-        kc = kc.at[li, :, :, :prompt_len].set(k)
-        vc = vc.at[li, :, :, :prompt_len].set(v)
-        h = h + _merge_heads(causal_attention_core(q, k, v)) @ bp["attn"]["wo"]
-        hn2 = layer_norm(bp["ln2"], h)
-        h = h + linear(bp["mlp_out"], jax.nn.gelu(linear(bp["mlp_in"], hn2)))
-        return h, kc, vc
 
     def _head_row(params, h_last):
         return log_softmax(linear(params["head"]["out"],
@@ -186,8 +127,9 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
                 else:
                     h = wire[:, :-1].reshape(b, prompt_len, d)
                 for li in range(n_blocks[s]):
-                    h, kc, vc = _block_prefill(params["blocks"][li], h, li,
-                                               kc, vc)
+                    h, kc, vc = _dense_block_prefill(params["blocks"][li],
+                                                     h, li, kc, vc,
+                                                     prompt_len, H)
                 tok = jnp.zeros((b,), jnp.float32)
                 if s == S - 1:
                     tok = _pick(_head_row(params, h[:, -1]), ks).astype(
@@ -243,8 +185,8 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
                 else:
                     h = wire[:, :-1].reshape(b, 1, d)
                 for li in range(n_blocks[s]):
-                    h, kc, vc = _block_step(params["blocks"][li], h, li,
-                                            kc, vc, i)
+                    h, kc, vc = _dense_block_step(params["blocks"][li], h,
+                                                  li, kc, vc, i, total, H)
                 tok_out = jnp.zeros((b,), jnp.float32)
                 if s == S - 1:
                     tok_out = _pick(_head_row(params, h[:, 0]), ks).astype(

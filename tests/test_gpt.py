@@ -347,3 +347,25 @@ def test_decoder_from_pipeline_uses_live_buffer():
                              jnp.asarray(data.y), jax.random.key(i))
     out1 = np.asarray(dec(buf, prompt, jax.random.key(0)))
     assert not np.array_equal(out0, out1), "decode ignored training updates"
+
+
+def test_generate_cfg_uses_cached_path():
+    """generate(..., cfg=) routes through the KV-cache decoder and returns
+    the exact recompute-path tokens."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        generate,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab)
+    want = generate(stages, prompt, n_new=5)
+    got = generate(stages, prompt, n_new=5, cfg=cfg)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    got_s = generate(stages, prompt, n_new=5, cfg=cfg, key=jax.random.key(2),
+                     temperature=0.9, top_k=4)
+    want_s = generate(stages, prompt, n_new=5, key=jax.random.key(2),
+                      temperature=0.9, top_k=4)
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
