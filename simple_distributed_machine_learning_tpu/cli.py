@@ -129,6 +129,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="expert-parallel width for --model=gpt with "
                         "--experts: shards expert weights over an 'expert' "
                         "mesh axis with all-to-all dispatch")
+    g.add_argument('--generate', type=int, default=0, metavar="N",
+                   help="for --model=gpt: after training, decode N tokens "
+                        "from the trained model (KV-cache, straight from "
+                        "the live param buffer) and print them on rank 0 — "
+                        "with --text-corpus, decoded bytes as text")
     g.add_argument('--text-corpus', default=None, metavar="PATH",
                    help="for --model=gpt: train on the BYTES of this local "
                         "file (vocab=256, next-byte LM, contiguous "
@@ -394,9 +399,66 @@ def _run_gpt(args, n_stages: int, key) -> None:
                          resume=not args.no_resume, zero1=args.zero1,
                          async_checkpoint=args.async_checkpoint,
                          shuffle=args.shuffle)
-    _fit(args, Trainer(pipe, train_ds, test_ds, config,
-                       opt=_make_opt(args, _total_steps(args, train_ds),
-                                     pipe)))
+    trainer = Trainer(pipe, train_ds, test_ds, config,
+                      opt=_make_opt(args, _total_steps(args, train_ds),
+                                    pipe))
+    _fit(args, trainer)
+    if args.generate > 0:
+        _print_sample(args, trainer, cfg, test_ds)
+
+
+def _print_sample(args, trainer, cfg, test_ds) -> None:
+    """--generate N: decode N tokens from the trained model (KV-cache path,
+    straight from the live packed buffer) and print them on rank 0 — for a
+    --text-corpus run this is the model writing text."""
+    import jax
+
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        decoder_from_pipeline,
+    )
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        _to_host,
+    )
+
+    n_new = min(args.generate, cfg.seq_len - 1)
+    t0 = max(1, min(cfg.seq_len - n_new, 16))
+    pipe = trainer.pipe
+    if cfg.n_experts > 0 or cfg.n_seq > 1:
+        trainer._print("| --generate: skipped (MoE/seq-parallel builds "
+                       "decode via models.make_decoder)")
+        return
+    if pipe.n_stages >= 2:
+        # pipeline-parallel decode: stage-sharded params stay put, so this
+        # works on multi-process meshes too (every rank participates; the
+        # batch shards over the data axis, hence B = n_data prompts)
+        from simple_distributed_machine_learning_tpu.models.pp_decode import (
+            make_pp_decoder,
+        )
+        B = pipe.n_data
+        if len(test_ds.x) < B:
+            trainer._print("| --generate: skipped (test set smaller than "
+                           "the data-parallel width)")
+            return
+        prompt = np.asarray(test_ds.x[:B, :t0], np.int32)
+        dec = make_pp_decoder(pipe, cfg, t0, n_new)
+    else:
+        if jax.process_count() > 1:
+            # a 1-stage multi-process buffer is not host-gatherable here
+            trainer._print("| --generate: skipped (single-stage multi-"
+                           "process run; decode from a checkpoint instead)")
+            return
+        prompt = np.asarray(test_ds.x[:1, :t0], np.int32)
+        dec = decoder_from_pipeline(pipe, cfg, t0, n_new)
+    toks = _to_host(dec(trainer.buf, prompt, jax.random.key(args.seed)))[0]
+    if args.text_corpus:
+        text = bytes(int(t) for t in toks).decode("latin-1")
+        trainer._print(f"| sample ({t0}-byte prompt + {n_new} generated):\n"
+                       f"{text!r}")
+    else:
+        trainer._print(f"| sample tokens (prompt {t0} + {n_new} generated): "
+                       f"{toks.tolist()}")
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ averages NLL over batch and sequence.
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
@@ -390,8 +391,6 @@ def _dense_block_step(bp, h, li, kc, vc, i, total, n_heads):
     """One block on ONE token [b, 1, d] against cache row ``li``; writes K/V
     at position ``i``. Same scale expression as causal_attention_core
     (divide by sqrt(dh)) so prefill and step compile to identical math."""
-    import math
-
     dh = h.shape[-1] // n_heads
     q, knew, vnew = _dense_qkv(bp, h, n_heads)          # [B,H,1,dh] each
     kc = jax.lax.dynamic_update_slice(kc, knew[None], (li, 0, 0, i, 0))

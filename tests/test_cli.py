@@ -74,3 +74,20 @@ def test_cli_gpt_text_corpus_end_to_end(tmp_path, capsys):
     import re
     losses = [float(m) for m in re.findall(r"Loss: ([0-9.]+)", out)]
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_cli_generate_prints_sample(tmp_path, capsys):
+    """--generate after --text-corpus training prints decoded text through
+    the KV-cache decoder bound to the live param buffer."""
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(b"abcabcabcabc " * 400)
+    main(["--rank", "0", "--world_size", "1", "--model", "gpt",
+          "--text-corpus", str(p), "--stages", "2", "--epochs", "1",
+          "--batch-size", "12", "--microbatches", "2", "--lr", "0.1",
+          "--generate", "24"])
+    out = capsys.readouterr().out
+    assert "| sample (" in out
+    # the sample line carries a 16-byte prompt + 24 generated characters
+    import ast
+    line = [l for l in out.splitlines() if l.startswith(("'", '"'))][-1]
+    assert len(ast.literal_eval(line)) == 40

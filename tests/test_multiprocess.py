@@ -112,10 +112,13 @@ def test_two_process_launch_reference_workload_lenet(tmp_path):
 def test_two_process_launch_gpt(tmp_path):
     """The GPT family end to end across a real process boundary: embedding
     stage on rank 0, head stage on rank 1, per-token LM loss, GPipe
-    microbatching — same verbatim launch line."""
+    microbatching — same verbatim launch line. --generate additionally
+    runs the pipeline-parallel KV-cache decoder across the SAME process
+    boundary (stage-sharded params, token relay over the cross-process
+    ring) and prints the sample on rank 0 only."""
     r0, r1 = run_two_ranks([
         "--model", "gpt", "--epochs", "1", "--microbatches", "2",
-        "--batch-size", "32",
+        "--batch-size", "32", "--generate", "8",
         "--data-root", str(tmp_path / "nodata"),
     ], timeout=560)
     assert r0.returncode == 0, f"rank0 failed:\n{r0.stderr[-3000:]}"
@@ -125,6 +128,8 @@ def test_two_process_launch_gpt(tmp_path):
     assert "Train Epoch" not in r1.stdout
     last = [ln for ln in r0.stdout.splitlines() if "Loss:" in ln][-1]
     assert "nan" not in last.lower()
+    assert "| sample tokens" in r0.stdout
+    assert "| sample tokens" not in r1.stdout
 
 
 def test_dead_peer_aborts_rank0(tmp_path):
