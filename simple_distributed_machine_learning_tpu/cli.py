@@ -240,6 +240,8 @@ def _dispatch(args) -> None:
         raise SystemExit("--sp is only supported with --model=gpt")
     if args.ep > 1 and (args.model != "gpt" or args.experts < 1):
         raise SystemExit("--ep needs --model=gpt with --experts > 0")
+    if args.generate > 0 and args.model != "gpt":
+        raise SystemExit("--generate is only supported with --model=gpt")
     if args.model == "gpt":
         _run_gpt(args, n_stages, key)
         return

@@ -91,3 +91,8 @@ def test_cli_generate_prints_sample(tmp_path, capsys):
     import ast
     line = [l for l in out.splitlines() if l.startswith(("'", '"'))][-1]
     assert len(ast.literal_eval(line)) == 40
+
+
+def test_cli_generate_requires_gpt():
+    with pytest.raises(SystemExit, match="--generate is only supported"):
+        main(["--rank", "0", "--model", "mlp", "--generate", "8"])
