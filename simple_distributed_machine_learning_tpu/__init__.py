@@ -22,7 +22,7 @@ Subpackages
 ``models``    MLP / LeNet / tiny-GPT expressed as pipeline stages
 ``train``     optimizers, train/eval driver, checkpointing
 ``data``      MNIST (IDX files or synthetic fallback), batching
-``utils``     metrics, timing, logging
+``utils``     metrics, profiling, failure detection (heartbeat watchdog)
 """
 
 __version__ = "0.1.0"

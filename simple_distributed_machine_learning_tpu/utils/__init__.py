@@ -1,1 +1,8 @@
-"""Utilities: metrics, timing, logging."""
+"""Utilities: metrics, profiling, failure detection.
+
+Deliberately NO re-exports here: the heartbeat watchdog's monitor runs as a
+stdlib-only subprocess via ``python -m ...utils.failure`` (see failure.py),
+whose import chain passes through this ``__init__`` — any eager import of
+``profiler`` (which imports jax) or siblings would break that isolation.
+Import the submodules directly.
+"""
