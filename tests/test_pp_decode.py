@@ -28,7 +28,7 @@ def _setup(n_stages, n_data=1):
     return stages, pipe, pipe.init_params()
 
 
-@pytest.mark.parametrize("n_stages,n_data", [(2, 1), (4, 1), (2, 2)])
+@pytest.mark.parametrize("n_stages,n_data", [(1, 1), (2, 1), (4, 1), (2, 2)])
 def test_pp_decode_matches_cached(n_stages, n_data):
     stages, pipe, buf = _setup(n_stages, n_data)
     prompt = jax.random.randint(jax.random.key(1), (4, 5), 0, CFG.vocab)
