@@ -5,6 +5,9 @@ LeNet with the reference's conv↔fc split, and a tiny GPT with GPipe
 microbatching.
 """
 
+from simple_distributed_machine_learning_tpu.models.beam import (  # noqa: F401
+    make_beam_decoder,
+)
 from simple_distributed_machine_learning_tpu.models.gpt import (  # noqa: F401
     GPTConfig,
     decoder_from_pipeline,

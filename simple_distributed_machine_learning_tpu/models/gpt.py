@@ -437,6 +437,23 @@ def _validate_decode_build(stages, cfg, prompt_len, n_new, caller):
     return total
 
 
+def _merged_stage_trees(params_list):
+    """Re-join per-stage param trees into ``(embed, blocks, head)`` — the
+    one copy shared by every single-device decoder (cached, beam)."""
+    embed = head = None
+    blocks = []
+    for p in params_list:
+        blocks.extend(p["blocks"])
+        embed = p.get("embed", embed)
+        head = p.get("head", head)
+    return embed, blocks, head
+
+
+def _head_logprobs(head, h_last):
+    """[B, d] final hidden -> [B, V] log-probs (ln_f + untied head)."""
+    return log_softmax(linear(head["out"], layer_norm(head["ln_f"], h_last)))
+
+
 def _sample_from(row, ks, temperature, top_k, top_p):
     """Scale/filter/categorical core on a PRE-SPLIT subkey ``ks`` (argmax
     when temperature == 0) — the ONE copy of the sampling math, shared by
@@ -561,20 +578,8 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
     H, d = cfg.n_heads, cfg.d_model
     dh = d // H
 
-    def _merged(params_list):
-        """Re-join the per-stage trees into (embed, blocks, head)."""
-        embed = head = None
-        blocks = []
-        for p in params_list:
-            blocks.extend(p["blocks"])
-            embed = p.get("embed", embed)
-            head = p.get("head", head)
-        return embed, blocks, head
-
-    def _head_row(head, h_last):
-        """[B, d] final hidden -> [B, V] log-probs."""
-        return log_softmax(linear(head["out"],
-                                  layer_norm(head["ln_f"], h_last)))
+    _merged = _merged_stage_trees
+    _head_row = _head_logprobs
 
     def _pick(row, k):
         return _sample_row(row, k, temperature, top_k, top_p)

@@ -1,0 +1,107 @@
+"""Beam-search decoder: greedy equivalence, score correctness, improvement."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.models.beam import (
+    make_beam_decoder,
+)
+from simple_distributed_machine_learning_tpu.models.gpt import (
+    GPTConfig,
+    make_cached_decoder,
+    make_gpt_stages,
+)
+from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+    fused_reference,
+)
+
+CFG = GPTConfig(vocab=32, seq_len=24, d_model=32, n_heads=2, n_layers=2)
+
+
+def _model():
+    stages, _, _ = make_gpt_stages(jax.random.key(0), CFG, 2)
+    return stages, [s.params for s in stages]
+
+
+def _seq_logprob(stages, params, seq, prompt_len):
+    """Cumulative log-prob of seq's generated suffix under the model."""
+    fused = fused_reference(stages)
+    buf = np.zeros((seq.shape[0], CFG.seq_len), np.float32)
+    buf[:, :seq.shape[1]] = np.asarray(seq)
+    logp = np.asarray(fused(params, jnp.asarray(buf), jax.random.key(0),
+                            True))
+    total = 0.0
+    for b in range(seq.shape[0]):
+        for pos in range(prompt_len - 1, seq.shape[1] - 1):
+            total += logp[b, pos, int(seq[b, pos + 1])]
+    return total
+
+
+def test_beam_size_1_is_greedy():
+    stages, params = _model()
+    prompt = jax.random.randint(jax.random.key(1), (3, 5), 0, CFG.vocab)
+    want = make_cached_decoder(stages, CFG, 5, 9)(
+        params, prompt, jax.random.key(0))
+    got, _ = make_beam_decoder(stages, CFG, 5, 9, beam_size=1)(
+        params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_beam_scores_are_true_cumulative_logprobs():
+    """The returned score must equal the model's own log-prob of the
+    returned sequence — recomputed independently via the fused forward."""
+    stages, params = _model()
+    prompt = jax.random.randint(jax.random.key(2), (2, 4), 0, CFG.vocab)
+    toks, scores = make_beam_decoder(stages, CFG, 4, 8, beam_size=3)(
+        params, prompt, jax.random.key(0))
+    toks = np.asarray(toks)
+    for b in range(2):
+        want = _seq_logprob(stages, params, toks[b:b + 1], 4)
+        np.testing.assert_allclose(float(scores[b]), want, rtol=2e-4,
+                                   atol=2e-4)
+
+
+def test_full_width_beam_is_exhaustive_argmax():
+    """With beam_size = vocab and n_new = 2 every 2-token continuation
+    survives the first expansion, so beam search must return the TRUE
+    argmax over all vocab^2 continuations — verified against brute-force
+    enumeration scored by the fused model. (Note a fixed-width beam does
+    NOT guarantee beating greedy in general — the greedy prefix can be
+    pruned mid-search — so exhaustive equivalence is the sound property to
+    pin, not greedy-dominance.)"""
+    stages, params = _model()
+    V = CFG.vocab
+    t0 = 4
+    prompt = jax.random.randint(jax.random.key(3), (1, t0), 0, V)
+    toks, score = make_beam_decoder(stages, CFG, t0, 2, beam_size=V)(
+        params, prompt, jax.random.key(0))
+
+    # brute force: score(t1, t2) = logp(prompt)[t1] + logp(prompt+t1)[t2]
+    fused = fused_reference(stages)
+
+    def logp_at(rows, pos):
+        buf = np.zeros((rows.shape[0], CFG.seq_len), np.float32)
+        buf[:, :rows.shape[1]] = rows
+        out = fused(params, jnp.asarray(buf), jax.random.key(0), True)
+        return np.asarray(out)[:, pos]
+
+    first = logp_at(np.asarray(prompt, np.float32), t0 - 1)[0]     # [V]
+    ext = np.repeat(np.asarray(prompt), V, axis=0)
+    ext = np.concatenate([ext, np.arange(V)[:, None]], axis=1)
+    second = logp_at(ext.astype(np.float32), t0)                   # [V, V]
+    table = first[:, None] + second
+    b1, b2 = np.unravel_index(np.argmax(table), table.shape)
+    np.testing.assert_array_equal(np.asarray(toks)[0, t0:],
+                                  [b1, b2])
+    np.testing.assert_allclose(float(score[0]), table[b1, b2],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_beam_validation():
+    stages, _ = _model()
+    with pytest.raises(ValueError, match="beam_size"):
+        make_beam_decoder(stages, CFG, 4, 4, beam_size=0)
+    with pytest.raises(ValueError, match="exceeds the model's sequence"):
+        make_beam_decoder(stages, CFG, 20, 9)
