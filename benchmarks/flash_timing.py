@@ -8,6 +8,13 @@ Prints one JSON line per (T, dtype) row:
     {"t": ..., "dtype": ..., "dense_ms": ..., "flash_ms": ..., "speedup": ...}
 and writes benchmarks/flash_timing.json.
 
+Each row also times jax.experimental.pallas.ops.tpu.flash_attention — the
+hand-tuned reference TPU kernel — as ``jaxref_ms`` with
+``jaxref_vs_dense = dense_ms / jaxref_ms``. That column is the ceiling
+check: if the best-known public Pallas kernel ALSO trails XLA dense at a
+given size on this chip, losing there is a property of the
+(size, chip, compiler) point, not of our kernel.
+
 Run on the TPU: python benchmarks/flash_timing.py
 """
 
@@ -102,6 +109,24 @@ def main() -> None:
                 raise
             dense_ms = None
         flash_ms = _time(flash, q, k, v)
+        jaxref_ms = None
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as jaxref_attn,
+            )
+            scale = 1.0 / math.sqrt(dh)
+            ref = jax.jit(functools.partial(
+                fwd_bwd,
+                functools.partial(jaxref_attn, causal=True, sm_scale=scale)))
+            lr_, _ = ref(q, k, v)
+            rel = abs(float(lr_) - float(lf)) / max(abs(float(lf)), 1e-9)
+            assert rel < (5e-2 if dtype == "bfloat16" else 1e-3), \
+                f"T={t} {dtype}: jaxref loss mismatch {float(lr_)} vs {float(lf)}"
+            jaxref_ms = _time(ref, q, k, v)
+        except Exception as e:  # noqa: BLE001 - reference kernel is advisory:
+            # an unsupported (size, dtype) point must not kill the sweep
+            print(json.dumps({"t": t, "dtype": dtype, "dh": dh,
+                              "jaxref_error": str(e)[:200]}))
         # dense_ms stays numeric-or-null (a string "OOM" broke consumers);
         # dense_oom carries the OOM fact separately
         row = {"t": t, "dtype": dtype, "b": B, "h": H, "dh": dh,
@@ -111,6 +136,11 @@ def main() -> None:
                "flash_ms": round(flash_ms, 3),
                "speedup": (round(dense_ms / flash_ms, 2)
                            if dense_ms is not None else None),
+               "jaxref_ms": (round(jaxref_ms, 3) if jaxref_ms is not None
+                             else None),
+               "jaxref_vs_dense": (round(dense_ms / jaxref_ms, 2)
+                                   if dense_ms is not None
+                                   and jaxref_ms is not None else None),
                "device": jax.devices()[0].device_kind}
         rows.append(row)
         print(json.dumps(row))
