@@ -26,19 +26,19 @@ if ! probe; then
   echo "[sweep] tunnel wedged (probe timed out) - aborting before any client"
   exit 17
 fi
-sleep 10
+sleep 20
 
 echo "[sweep] 1/4 flash_timing (fwd+bwd, incl. dh=128 and T=8192 rows)"
 timeout 2400 python benchmarks/flash_timing.py || echo "[sweep] flash_timing rc=$?"
-sleep 15
+sleep 60
 
 echo "[sweep] 2/4 bench --all (all configs + decode row)"
 timeout 3000 python bench.py --all || echo "[sweep] bench --all rc=$?"
-sleep 15
+sleep 60
 
 echo "[sweep] 3/4 bench --config gpt_bf16_xl (MXU-stretch MFU row)"
 timeout 1800 python bench.py --config gpt_bf16_xl || echo "[sweep] xl rc=$?"
-sleep 15
+sleep 60
 
 echo "[sweep] 4/4 flash_tune block sweep (log: benchmarks/flash_tune.log)"
 timeout 3000 python benchmarks/flash_tune.py | tee benchmarks/flash_tune.log \

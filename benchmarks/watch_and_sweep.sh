@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# Patient follow-up sweep: probe the wedged tunnel every 10 minutes and, the
+# moment it answers, run the round-5 remaining measurements with the strict
+# single-client discipline (60 s settle between clients, generous timeouts,
+# never kill a client mid-dispatch). See BASELINE.md incident notes.
+#
+# Steps (value order):
+#   1. flash_tune block sweep        -> benchmarks/flash_tune.log
+#   2. flash_timing (jaxref column)  -> benchmarks/flash_timing.json
+#   3. bench --decode (fixed harness)-> benchmarks/decode_timing.json
+#   4. gpt_bf16 with sgd lr=0.01     -> stdout row (experiment, no artifact)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python -c \
+    "import jax, jax.numpy as jnp; print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))" \
+    >/dev/null 2>&1
+}
+
+deadline=$(( $(date +%s) + 8*3600 ))
+n=0
+while true; do
+  n=$((n+1))
+  echo "[watch] probe #$n $(date -u +%H:%M:%S)"
+  if probe; then
+    echo "[watch] tunnel ALIVE at $(date -u +%H:%M:%S) - starting sweep"
+    break
+  fi
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "[watch] 8h deadline reached, tunnel never answered - giving up"
+    exit 17
+  fi
+  sleep 600
+done
+
+# settle after the previous client, then re-probe before launching the next
+# one: if a step's client timed out (SIGTERM mid-dispatch can re-wedge the
+# tunnel for hours - BASELINE.md incident notes), burning the remaining
+# steps' timeouts against a wedged tunnel only deepens the wedge. Probes at
+# acquisition are safe to kill; clients mid-dispatch are not.
+settle_probe() {
+  sleep 60
+  for i in 1 2 3; do
+    if probe; then return 0; fi
+    echo "[watch] inter-step probe $i/3 failed $(date -u +%H:%M:%S)"
+    sleep 120
+  done
+  echo "[watch] tunnel wedged between steps - aborting remaining steps"
+  exit 17
+}
+
+sleep 60
+echo "[watch] 1/4 flash_tune block sweep"
+timeout 3000 python benchmarks/flash_tune.py > benchmarks/flash_tune.log 2>&1 \
+  || echo "[watch] flash_tune rc=$?"
+settle_probe
+
+echo "[watch] 2/4 flash_timing (incl. jaxref column)"
+timeout 2400 python benchmarks/flash_timing.py || echo "[watch] flash_timing rc=$?"
+settle_probe
+
+echo "[watch] 3/4 bench --decode (fixed harness)"
+timeout 1800 python bench.py --decode || echo "[watch] decode rc=$?"
+settle_probe
+
+echo "[watch] 4/4 gpt_bf16 sgd lr=0.01 stability/throughput probe"
+timeout 1800 python bench.py --config gpt_bf16 --opt sgd --lr 0.01 \
+  || echo "[watch] bf16-sgd rc=$?"
+
+echo "[watch] done $(date -u +%H:%M:%S)"
