@@ -258,11 +258,40 @@ def main() -> None:
 
         return Optimizer(init, update)
 
+    def adamw_mulform(lr, b1=0.9, b2=0.999, eps=1e-8, wd=0.01) -> Optimizer:
+        """Candidate fix: torch-identical AdamW where every traced
+        bias-correction enters as a MULTIPLY and eps stays a CONSTANT add —
+        update = p*(1-lr*wd) - (lr/bc1)*m / (sqrt(v*(1/bc2)) + eps), which
+        is exactly torch's m_hat / (sqrt(v_hat) + eps) form."""
+
+        def init(params):
+            zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+            return (jnp.zeros((), jnp.int32), zeros(), zeros())
+
+        def update(grads, state, params):
+            step, m, v = state
+            step = step + 1
+            t = step.astype(jnp.float32)
+            m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
+            v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v,
+                             grads)
+            scaled_lr = lr / (1 - b1 ** t)        # scalar ops only
+            inv_bc2 = 1.0 / (1 - b2 ** t)
+
+            def upd(p, m_, v_):
+                denom = jnp.sqrt(v_ * inv_bc2) + eps
+                return p * (1 - lr * wd) - (scaled_lr * m_) / denom
+
+            return jax.tree.map(upd, params, m, v), (step, m, v)
+
+        return Optimizer(init, update)
+
     rows = {}
     variants = (("adamw", adamw(1e-3)),
                 ("adamw_nobias", adamw_nobias(1e-3)),
                 ("adamw_nobias_wd", adamw_nobias_wd(1e-3)),
-                ("adamw_eps_traced", adamw_eps_traced(1e-3)))
+                ("adamw_eps_traced", adamw_eps_traced(1e-3)),
+                ("adamw_mulform", adamw_mulform(1e-3)))
     if os.environ.get("OPT_COST_FULL"):
         variants = (("sgd", sgd(0.1, momentum=0.5)),) + variants + (
             ("two_buffer_sgd", two_buffer_sgd(0.1)),

@@ -100,23 +100,35 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1,
         # packed [1, 1, P] buffer costs ~10x the model itself per scan
         # iteration (the slice/concat machinery's autodiff). Unpack params and
         # any buffer-shaped optimizer state to pytrees ONCE per window, scan
-        # on pytrees, repack at the end. Requires elementwise (buffer-shaped)
-        # opt state — true for the built-in SGD; anything else falls through
-        # to the generic path.
+        # on pytrees, repack at the end. Buffer-shaped state leaves (SGD
+        # momentum, AdamW m/v) are unpacked alongside the params; scalar
+        # leaves (step counters, carried bias-correction powers) pass through
+        # unchanged — excluding them from this path sent every
+        # counter-carrying optimizer down the packed-buffer engine, which
+        # XLA:CPU compiles to ~1.9x the bytes and ~7x the live temp of the
+        # pytree path for AdamW (benchmarks/opt_cost_analysis.py, the
+        # round-5 "AdamW halves gpt_bf16" regression).
         os_leaves, os_def = jax.tree.flatten(opt_state)
+
+        def _buf_shaped(l):
+            return getattr(l, "shape", None) == buf.shape
+
         unpackable = trivial_mesh and all(
-            getattr(l, "shape", None) == buf.shape for l in os_leaves)
+            _buf_shaped(l) or getattr(l, "ndim", None) == 0
+            for l in os_leaves)
 
         if unpackable:
             meta = pipe.metas[0]
             stage = pipe.stages[0]
+            buf_slot = [_buf_shaped(l) for l in os_leaves]
 
             def repack(tree):
                 return pack_stage_params([tree])[0].reshape(buf.shape)
 
             params0 = unpack_stage_params(buf[0, 0, 0], meta)
             state0 = jax.tree.unflatten(os_def, [
-                unpack_stage_params(l[0, 0, 0], meta) for l in os_leaves])
+                unpack_stage_params(l[0, 0, 0], meta) if is_buf else l
+                for l, is_buf in zip(os_leaves, buf_slot)])
 
             def loss_tree(pp, x, t, k):
                 # same math and RNG stream as Pipeline._fused_loss
@@ -144,10 +156,13 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1,
                 return (p2, s2, i + 1), loss
 
             (p2, s2, _), losses = scan_batches(body, (params0, state0, 0))
-            # s2's "leaves" (per packed-state slot) are params-shaped trees;
-            # flatten_up_to recovers them for repacking
+            # s2's buffer-slot "leaves" are params-shaped trees
+            # (flatten_up_to recovers them for repacking); scalar slots come
+            # back as the scalars they are
             opt2 = jax.tree.unflatten(
-                os_def, [repack(t_) for t_ in os_def.flatten_up_to(s2)])
+                os_def, [repack(t_) if is_buf else t_
+                         for t_, is_buf in zip(os_def.flatten_up_to(s2),
+                                               buf_slot)])
             return repack(p2), opt2, losses
 
         def body(carry, batch):
