@@ -287,13 +287,19 @@ def main() -> None:
         return Optimizer(init, update)
 
     rows = {}
-    variants = (("adamw", adamw(1e-3)),
-                ("adamw_nobias", adamw_nobias(1e-3)),
-                ("adamw_nobias_wd", adamw_nobias_wd(1e-3)),
-                ("adamw_eps_traced", adamw_eps_traced(1e-3)),
-                ("adamw_mulform", adamw_mulform(1e-3)))
+    # Default: just the sgd-vs-adamw fast-path comparison that regression-
+    # guards the gate fix. The update-formula rewrites (nobias/eps_traced/
+    # mulform/folded/...) were diagnostic probes for the round-5 packed-path
+    # investigation; it concluded the blowup tracked the state-shape gate,
+    # not the arithmetic (BASELINE.md), so they are retired to OPT_COST_FULL.
+    variants = (("sgd", sgd(0.1, momentum=0.5)),
+                ("adamw", adamw(1e-3)))
     if os.environ.get("OPT_COST_FULL"):
-        variants = (("sgd", sgd(0.1, momentum=0.5)),) + variants + (
+        variants = variants + (
+            ("adamw_nobias", adamw_nobias(1e-3)),
+            ("adamw_nobias_wd", adamw_nobias_wd(1e-3)),
+            ("adamw_eps_traced", adamw_eps_traced(1e-3)),
+            ("adamw_mulform", adamw_mulform(1e-3)),
             ("two_buffer_sgd", two_buffer_sgd(0.1)),
             ("adamw_running", adamw_running(1e-3)),
             ("sgd_counted", sgd_counted(0.1)),

@@ -126,6 +126,26 @@ def clip_by_global_norm(opt: Optimizer, max_norm: float,
         leaves = jax.tree.leaves(grads)
         wts = ([None] * len(leaves) if norm_weights is None
                else jax.tree.leaves(norm_weights))
+        if len(wts) != len(leaves):
+            # Structure mismatch: the weights were built against the packed
+            # [S, M, E, P] buffer but the grads arrived as per-param pytrees
+            # (make_scanned_train_step's single-device fast path unpacks the
+            # buffer before the scan). That path only exists on a trivial
+            # mesh, where the replication correction is exactly 1 — verify
+            # and drop it rather than silently zip-truncating the norm to
+            # the first gradient leaf.
+            import numpy as np
+            try:
+                identity = all(np.all(np.asarray(w) == 1.0) for w in wts)
+            except Exception:
+                identity = False
+            if not identity:
+                raise ValueError(
+                    f"clip_by_global_norm: norm_weights has {len(wts)} "
+                    f"leaves but grads has {len(leaves)}; non-identity "
+                    "replication weights cannot be applied to unpacked "
+                    "per-param gradients")
+            wts = [None] * len(leaves)
         sq = jnp.float32(0.0)
         for g, w in zip(leaves, wts):
             g2 = g.astype(jnp.float32) ** 2

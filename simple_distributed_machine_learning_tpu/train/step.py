@@ -105,7 +105,7 @@ def make_scanned_train_step(pipe: Pipeline, opt: Optimizer, unroll: int = 1,
         # leaves (step counters, carried bias-correction powers) pass through
         # unchanged — excluding them from this path sent every
         # counter-carrying optimizer down the packed-buffer engine, which
-        # XLA:CPU compiles to ~1.9x the bytes and ~7x the live temp of the
+        # XLA:CPU compiles to ~1.4x the bytes and ~7x the live temp of the
         # pytree path for AdamW (benchmarks/opt_cost_analysis.py, the
         # round-5 "AdamW halves gpt_bf16" regression).
         os_leaves, os_def = jax.tree.flatten(opt_state)

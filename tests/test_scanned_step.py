@@ -161,3 +161,59 @@ def test_adamw_rides_unpacked_fast_path():
     assert temp_ratio < 0.7, (
         f"AdamW live temp {temp_ratio:.2f}x the forced-packed engine - "
         f"did the fast-path gate regress for every optimizer?")
+
+
+def test_scanned_clip_single_device_matches_loop():
+    """clip_by_global_norm(adamw, ..., replication_weights()) on the trivial
+    mesh: the scanned fast path unpacks grads to per-param pytrees, so the
+    packed-buffer norm_weights no longer align leaf-for-leaf. Regression for
+    the silent zip-truncation that computed the global norm from the FIRST
+    gradient leaf only (under-clipping); the wrapper must detect the
+    identity-weight case, drop the weights, and match the per-step packed
+    loop exactly — with max_norm small enough that clipping is ACTIVE."""
+    from simple_distributed_machine_learning_tpu.train.optimizer import (
+        adamw,
+        clip_by_global_norm,
+    )
+
+    key = jax.random.key(7)
+    stages, wd, od = make_mlp_stages(key, [12, 16, 10], 1)
+    mesh = make_mesh(n_stages=1, n_data=1)
+    pipe = Pipeline(stages, mesh, wd, od, n_microbatches=1)
+    # max_norm far below a fresh-init nll gradient's global norm: every step
+    # clips, so a wrong norm changes the trajectory
+    opt = clip_by_global_norm(adamw(5e-3), 1e-3, pipe.replication_weights())
+
+    n_steps, batch = 4, 8
+    xs = jax.random.normal(key, (n_steps, batch, 12))
+    ts = jax.random.randint(key, (n_steps, batch), 0, 10)
+
+    buf_a = pipe.init_params()
+    st_a = opt.init(buf_a)
+    scanned = make_scanned_train_step(pipe, opt)
+    buf_a, st_a, losses = scanned(buf_a, st_a, xs, ts, key)
+
+    buf_b = pipe.init_params()
+    st_b = opt.init(buf_b)
+    step = make_train_step(pipe, opt)     # packed path: weights align
+    loop_losses = []
+    for i in range(n_steps):
+        buf_b, st_b, l = step(buf_b, st_b, xs[i], ts[i],
+                              jax.random.fold_in(key, i))
+        loop_losses.append(float(l))
+
+    np.testing.assert_allclose(np.asarray(losses), loop_losses,
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(buf_a), np.asarray(buf_b),
+                               rtol=2e-5, atol=2e-5)
+
+    # non-identity weights CANNOT be mapped onto unpacked grads — loud error,
+    # not a silently wrong norm
+    import pytest
+
+    bad = clip_by_global_norm(adamw(5e-3), 1e-3,
+                              0.5 * pipe.replication_weights())
+    buf_c = pipe.init_params()
+    st_c = bad.init(buf_c)
+    with pytest.raises(ValueError, match="non-identity"):
+        make_scanned_train_step(pipe, bad)(buf_c, st_c, xs, ts, key)
