@@ -150,13 +150,25 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
     # bh and q-blocks are independent; the k axis carries scratch state
     compiler_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    # Causal fetch elision: the kernel predicates off compute for k-blocks
+    # wholly past the diagonal, but an unclamped index map would still FETCH
+    # those blocks from HBM every iteration — rectangular K/V traffic for
+    # triangular work, and the traffic grows with T (the r4 "flash trails
+    # dense more the longer the sequence" signature). Clamping the k index
+    # at the last needed block makes consecutive skipped iterations revisit
+    # the same block, which Mosaic's pipeline elides (no copy when the block
+    # index is unchanged) — K/V HBM reads drop ~2x for causal.
+    def _kv_idx(i, j, kb):
+        return (i, jnp.minimum(kb, ((j + 1) * block_q - 1) // block_k), 0)
+
     o, l, m = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, dp), _kv_idx),
+            pl.BlockSpec((1, block_k, dp), _kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dp), lambda i, j, kb: (i, j, 0)),
@@ -343,7 +355,12 @@ def _flash_bwd(block_q, block_k, res, do):
     n_qb, n_kb = tq // block_q, tk // block_k
 
     q_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0))
-    k_spec = pl.BlockSpec((1, block_k, dp_), lambda i, j, kb: (i, kb, 0))
+    # clamp past-diagonal k fetches to the last needed block (same causal
+    # fetch elision as the forward — skipped cells must not cost HBM reads)
+    k_spec = pl.BlockSpec(
+        (1, block_k, dp_),
+        lambda i, j, kb: (i, jnp.minimum(kb, ((j + 1) * block_q - 1)
+                                         // block_k), 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j))
     compiler_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
@@ -361,10 +378,18 @@ def _flash_bwd(block_q, block_k, res, do):
         interpret=_interpret(),
     )(qp, kp, vp, dop, mp, linvp, dlp)
 
-    # dkv grid: (bh, k-block, q-block) — index maps select by the axis kind
+    # dkv grid: (bh, k-block, q-block) — index maps select by the axis kind.
+    # Pre-diagonal q-blocks see none of this k block: clamp their fetches up
+    # to the first needed q block (fetch elision, mirror of the forward)
+    def _q_idx(i, j, qb):
+        return (i, jnp.maximum(qb, (j * block_k) // block_q), 0)
+
+    def _row_idx(i, j, qb):
+        return (i, 0, jnp.maximum(qb, (j * block_k) // block_q))
+
     kv_spec = pl.BlockSpec((1, block_k, dp_), lambda i, j, qb: (i, j, 0))
-    qi_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, qb: (i, qb, 0))
-    rowi_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, qb: (i, 0, qb))
+    qi_spec = pl.BlockSpec((1, block_q, dp_), _q_idx)
+    rowi_spec = pl.BlockSpec((1, 1, block_q), _row_idx)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
                           t_real=t, scale=scale),

@@ -28,6 +28,8 @@ _dense_reference = causal_attention_core
     (64, 32, 16, 16),     # blocks divide T
     (48, 32, 16, 16),     # T not a multiple of the block: padding path
     (64, 32, 64, 64),     # single block
+    (64, 32, 16, 32),     # block_q < block_k: diagonal crosses mid k-block
+    (64, 32, 32, 16),     # block_q > block_k: several k blocks per q block
 ])
 def test_flash_matches_dense_forward(t, dh, bq, bk):
     key = jax.random.key(0)
@@ -66,6 +68,8 @@ def test_flash_gradients_match_dense():
 @pytest.mark.parametrize("t,bq,bk", [
     (48, 16, 16),     # T not a multiple of the block: backward padding path
     (32, 32, 32),     # single block each way
+    (64, 16, 32),     # asymmetric: dq clamp crosses mid k-block
+    (64, 32, 16),     # asymmetric: dkv clamp starts mid q-block
 ])
 def test_flash_gradients_match_dense_padded(t, bq, bk):
     key = jax.random.key(7)
