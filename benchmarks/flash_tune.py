@@ -64,8 +64,13 @@ def main():
                 print(json.dumps({"t": t, "dh": dh,
                                   "dense": f"FAIL {str(e)[:120]}",
                                   "dense_oom": dense_oom}))
-            for bq in (128, 256, 512):
-                for bk in (128, 256, 512, 1024):
+            # trimmed grid: every point costs a fwd+bwd XLA compile on chip
+            # (~30-45 s through the tunnel), and overrunning the step timeout
+            # risks a mid-dispatch SIGTERM wedge. (128,128) is the default
+            # baseline; larger bq cuts K/V passes (the r4 refetch diagnosis),
+            # larger bk cuts grid steps.
+            for bq, bk in ((128, 128), (256, 256), (256, 512),
+                           (512, 256), (512, 512), (512, 1024)):
                     if bq > t or bk > t:
                         continue
                     attn = functools.partial(flash_attention,

@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Round-5 remaining on-chip measurements, in value order, with the strict
+# single-client discipline (see BASELINE.md incident notes): bounded smoke
+# probe first, strictly sequential clients, 60 s settle + re-probe between
+# clients, generous timeouts, never kill a client mid-dispatch.
+#
+# Steps (value order):
+#   1. flash_tune block sweep         -> benchmarks/flash_tune.log
+#   2. flash_timing (jaxref column)   -> benchmarks/flash_timing.json
+#   3. bench --all (AdamW-fixed bf16 rows + fixed decode harness)
+#                                     -> benchmarks/results_all.json,
+#                                        benchmarks/decode_timing.json
+#   4. bench --config gpt_bf16_xl     -> MXU-stretch MFU row
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python -c \
+    "import jax, jax.numpy as jnp; print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))" \
+    >/dev/null 2>&1
+}
+
+# Patient acquisition: after ANY client exits (including our own probes) the
+# server can take minutes to re-grant the claim, so a single failed probe is
+# not a wedge verdict. Probe every 10 minutes up to a deadline.
+deadline=$(( $(date +%s) + 6*3600 ))
+n=0
+while true; do
+  n=$((n+1))
+  echo "[r5] probe #$n $(date -u +%H:%M:%S)"
+  if probe; then
+    echo "[r5] tunnel ALIVE at $(date -u +%H:%M:%S) - starting sweep"
+    break
+  fi
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "[r5] 6h deadline reached, tunnel never answered - giving up"
+    exit 17
+  fi
+  sleep 600
+done
+sleep 60
+
+settle_probe() {
+  sleep 60
+  for i in 1 2 3; do
+    if probe; then sleep 30; return 0; fi
+    echo "[r5] inter-step probe $i/3 failed $(date -u +%H:%M:%S)"
+    sleep 120
+  done
+  echo "[r5] tunnel wedged between steps - aborting remaining steps"
+  exit 17
+}
+
+# Ordering: the known-good artifact refreshes run FIRST; the compile-heavy
+# flash_tune sweep runs LAST with the most generous timeout, because a
+# timeout SIGTERM mid-dispatch can wedge the tunnel for hours (BASELINE.md)
+# and must not take the core artifacts down with it.
+echo "[r5] 1/4 bench --all (AdamW-fixed rows + decode) $(date -u +%H:%M:%S)"
+timeout 3000 python bench.py --all || echo "[r5] bench --all rc=$?"
+settle_probe
+
+echo "[r5] 2/4 bench --config gpt_bf16_xl $(date -u +%H:%M:%S)"
+timeout 1800 python bench.py --config gpt_bf16_xl || echo "[r5] xl rc=$?"
+settle_probe
+
+echo "[r5] 3/4 flash_timing (incl. jaxref column) $(date -u +%H:%M:%S)"
+timeout 2400 python benchmarks/flash_timing.py || echo "[r5] flash_timing rc=$?"
+settle_probe
+
+echo "[r5] 4/4 flash_tune block sweep $(date -u +%H:%M:%S)"
+timeout 4800 python benchmarks/flash_tune.py > benchmarks/flash_tune.log 2>&1 \
+  || echo "[r5] flash_tune rc=$?"
+tail -5 benchmarks/flash_tune.log
+
+echo "[r5] done $(date -u +%H:%M:%S)"
