@@ -444,7 +444,8 @@ def _print_sample(args, trainer, cfg, test_ds) -> None:
                            "the data-parallel width)")
             return
         prompt = np.asarray(test_ds.x[:B, :t0], np.int32)
-        dec = make_pp_decoder(pipe, cfg, t0, n_new)
+        dec = make_pp_decoder(pipe, cfg, t0, n_new,
+                              cache_dtype=_compute_dtype(args))
     else:
         if jax.process_count() > 1:
             # a 1-stage multi-process buffer is not host-gatherable here
@@ -452,7 +453,8 @@ def _print_sample(args, trainer, cfg, test_ds) -> None:
                            "process run; decode from a checkpoint instead)")
             return
         prompt = np.asarray(test_ds.x[:1, :t0], np.int32)
-        dec = decoder_from_pipeline(pipe, cfg, t0, n_new)
+        dec = decoder_from_pipeline(pipe, cfg, t0, n_new,
+                                    cache_dtype=_compute_dtype(args))
     toks = _to_host(dec(trainer.buf, prompt, jax.random.key(args.seed)))[0]
     if args.text_corpus:
         text = bytes(int(t) for t in toks).decode("latin-1")

@@ -39,9 +39,10 @@ from simple_distributed_machine_learning_tpu.ops.layers import (
 
 
 def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
-                      beam_size: int = 4):
+                      beam_size: int = 4, cache_dtype=None):
     """Build the jitted beam decoder. Single-device dense builds only (the
-    :func:`~.gpt.make_cached_decoder` restrictions)."""
+    :func:`~.gpt.make_cached_decoder` restrictions; ``cache_dtype`` as there
+    — bf16 halves the K*B beam-cache memory)."""
     if cfg.n_seq > 1:
         raise ValueError(
             "beam decode is single-device; rebuild the stages with n_seq=1")
@@ -54,6 +55,7 @@ def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
     H, d = cfg.n_heads, cfg.d_model
     dh = d // H
     V = cfg.vocab
+    cd = jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
 
     @jax.jit
     def decode(params, prompt, key):
@@ -63,8 +65,8 @@ def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
         L = len(blocks)
 
         # ---- prefill at batch B (beams share the prompt prefix)
-        kc = jnp.zeros((L, b, H, total, dh), jnp.float32)
-        vc = jnp.zeros((L, b, H, total, dh), jnp.float32)
+        kc = jnp.zeros((L, b, H, total, dh), cd)
+        vc = jnp.zeros((L, b, H, total, dh), cd)
         ids = prompt.astype(jnp.int32)
         h = embedding_lookup(embed["tok"], ids) + embed["pos"][:prompt_len]
         for li, bp in enumerate(blocks):

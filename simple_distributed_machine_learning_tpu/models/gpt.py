@@ -380,21 +380,25 @@ def _dense_attn_tail(bp, h, a):
 
 def _dense_block_prefill(bp, h, li, kc, vc, prompt_len, n_heads):
     """One block over the whole prompt [b, T0, d], recording cache row
-    ``li`` for positions [0, prompt_len)."""
+    ``li`` for positions [0, prompt_len). K/V are cast to the cache's dtype
+    (a bf16 cache halves decode memory; reads promote back in the einsum)."""
     q, k, v = _dense_qkv(bp, h, n_heads)
-    kc = kc.at[li, :, :, :prompt_len].set(k)
-    vc = vc.at[li, :, :, :prompt_len].set(v)
+    kc = kc.at[li, :, :, :prompt_len].set(k.astype(kc.dtype))
+    vc = vc.at[li, :, :, :prompt_len].set(v.astype(vc.dtype))
     return _dense_attn_tail(bp, h, causal_attention_core(q, k, v)), kc, vc
 
 
 def _dense_block_step(bp, h, li, kc, vc, i, total, n_heads):
     """One block on ONE token [b, 1, d] against cache row ``li``; writes K/V
-    at position ``i``. Same scale expression as causal_attention_core
-    (divide by sqrt(dh)) so prefill and step compile to identical math."""
+    at position ``i`` (cast to the cache's dtype). Same scale expression as
+    causal_attention_core (divide by sqrt(dh)) so prefill and step compile
+    to identical math."""
     dh = h.shape[-1] // n_heads
     q, knew, vnew = _dense_qkv(bp, h, n_heads)          # [B,H,1,dh] each
-    kc = jax.lax.dynamic_update_slice(kc, knew[None], (li, 0, 0, i, 0))
-    vc = jax.lax.dynamic_update_slice(vc, vnew[None], (li, 0, 0, i, 0))
+    kc = jax.lax.dynamic_update_slice(kc, knew[None].astype(kc.dtype),
+                                      (li, 0, 0, i, 0))
+    vc = jax.lax.dynamic_update_slice(vc, vnew[None].astype(vc.dtype),
+                                      (li, 0, 0, i, 0))
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc[li]) / math.sqrt(dh)
     live = (jnp.arange(total) <= i)[None, None, None, :]
     scores = jnp.where(live, scores, -jnp.inf)
@@ -536,7 +540,7 @@ def generate(stages, prompt: jax.Array, n_new: int,
 
 def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
                         temperature: float = 0.0, top_k: int | None = None,
-                        top_p: float | None = None):
+                        top_p: float | None = None, cache_dtype=None):
     """KV-cache decode: ``decode(params, prompt, key) -> [B, prompt_len+n_new]``.
 
     Same contract as :func:`make_decoder` but O(T) per generated token instead
@@ -577,6 +581,11 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
                                    "make_cached_decoder")
     H, d = cfg.n_heads, cfg.d_model
     dh = d // H
+    # cache_dtype: K/V cache storage dtype (None = f32). bf16 HALVES decode
+    # memory — the cache is the dominant inference allocation at
+    # L x B x H x total x dh x 2 buffers — at ~1e-3 relative logit error
+    # (attention math still accumulates in f32 via einsum promotion).
+    cd = jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
 
     _merged = _merged_stage_trees
     _head_row = _head_logprobs
@@ -589,8 +598,8 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
         embed, blocks, head = _merged(params)
         b = prompt.shape[0]
         L = len(blocks)
-        kc = jnp.zeros((L, b, H, total, dh), jnp.float32)
-        vc = jnp.zeros((L, b, H, total, dh), jnp.float32)
+        kc = jnp.zeros((L, b, H, total, dh), cd)
+        vc = jnp.zeros((L, b, H, total, dh), cd)
 
         # --- prefill: one dense causal pass over the whole prompt, recording
         # every layer's K/V rows for positions [0, prompt_len)
@@ -630,7 +639,7 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
 
 def decoder_from_pipeline(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
                           temperature: float = 0.0, top_k: int | None = None,
-                          top_p: float | None = None):
+                          top_p: float | None = None, cache_dtype=None):
     """Cached decode bound to a training :class:`~..parallel.pipeline.Pipeline`:
     returns ``decode(buf, prompt, key)`` taking the LIVE packed param buffer.
 
@@ -649,7 +658,7 @@ def decoder_from_pipeline(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
             "tensor/expert shards into a dense build first")
     dec = make_cached_decoder(pipe.stages, cfg, prompt_len, n_new,
                               temperature=temperature, top_k=top_k,
-                              top_p=top_p)
+                              top_p=top_p, cache_dtype=cache_dtype)
 
     def decode(buf, prompt, key):
         return dec(pipe.unpack(buf), prompt, key)

@@ -63,7 +63,7 @@ from simple_distributed_machine_learning_tpu.parallel.staging import (
 
 def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
                     temperature: float = 0.0, top_k: int | None = None,
-                    top_p: float | None = None):
+                    top_p: float | None = None, cache_dtype=None):
     """Build ``decode(buf, prompt, key) -> [B, prompt_len + n_new]`` tokens,
     stage-sharded end to end. ``buf`` is the pipeline's packed param buffer
     (the live training state); ``prompt``: [B, prompt_len] int tokens with
@@ -105,12 +105,15 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
 
     fwd = [(i, (i + 1) % S) for i in range(S)]
 
+    # cache_dtype: as make_cached_decoder (bf16 halves each stage's cache)
+    cd = jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
+
     def per_device(row4d, prompt, key):
         row = row4d[0, 0, 0]
         stage = lax.axis_index(STAGE_AXIS)
         b = prompt.shape[0]
-        kc = jnp.zeros((L_max, b, H, total, dh), jnp.float32)
-        vc = jnp.zeros((L_max, b, H, total, dh), jnp.float32)
+        kc = jnp.zeros((L_max, b, H, total, dh), cd)
+        vc = jnp.zeros((L_max, b, H, total, dh), cd)
         kc = _pvary_to(kc, vary)
         vc = _pvary_to(vc, vary)
 
@@ -139,7 +142,8 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
                 anchor = _pvary_to(jnp.float32(0.0) * (jnp.sum(wire)
                                                        + jnp.sum(row)), vary)
                 return (_pvary_to(out, vary) + anchor,
-                        jax.tree.map(lambda a: _pvary_to(a, vary) + anchor,
+                        jax.tree.map(lambda a: (_pvary_to(a, vary)
+                                                + anchor.astype(a.dtype)),
                                      (kc, vc)))
             return br
 
@@ -196,7 +200,8 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
                 anchor = _pvary_to(jnp.float32(0.0) * (jnp.sum(wire)
                                                        + jnp.sum(row)), vary)
                 return (_pvary_to(out, vary) + anchor,
-                        jax.tree.map(lambda a: _pvary_to(a, vary) + anchor,
+                        jax.tree.map(lambda a: (_pvary_to(a, vary)
+                                                + anchor.astype(a.dtype)),
                                      (kc, vc)))
             return br
 

@@ -212,6 +212,41 @@ def test_cached_decoder_matches_recompute():
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_bf16_cache_decoders_match_f32():
+    """cache_dtype=bf16 halves decode memory; greedy tokens must match the
+    f32-cache decoders on this (deterministic) model — bf16 K/V error is
+    orders of magnitude below the argmax logit gaps here. Covers the cached
+    and beam decoders (the pp decoder shares the same block helpers)."""
+    from simple_distributed_machine_learning_tpu.models.beam import (
+        make_beam_decoder,
+    )
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_cached_decoder,
+        make_gpt_stages,
+    )
+
+    cfg = GPTConfig(vocab=32, seq_len=24, d_model=32, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, 2)
+    params = [s.params for s in stages]
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab)
+
+    want = make_cached_decoder(stages, cfg, 6, 10)(
+        params, prompt, jax.random.key(0))
+    got = make_cached_decoder(stages, cfg, 6, 10, cache_dtype=jnp.bfloat16)(
+        params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    bw, bs = make_beam_decoder(stages, cfg, 6, 8, beam_size=3)(
+        params, prompt, jax.random.key(0))
+    gw, gs = make_beam_decoder(stages, cfg, 6, 8, beam_size=3,
+                               cache_dtype=jnp.bfloat16)(
+        params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(gw), np.asarray(bw))
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(bs),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_cached_decoder_validation():
     from simple_distributed_machine_learning_tpu.models.gpt import (
         GPTConfig,

@@ -38,6 +38,18 @@ def test_pp_decode_matches_cached(n_stages, n_data):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_pp_decode_bf16_cache_matches_f32():
+    """cache_dtype=bf16 through the stage-sharded decoder: the replication
+    anchors must not silently promote the carried caches back to f32, and
+    greedy tokens must match the f32-cache run on this model."""
+    stages, pipe, buf = _setup(2)
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 0, CFG.vocab)
+    want = make_pp_decoder(pipe, CFG, 5, 7)(buf, prompt, jax.random.key(3))
+    got = make_pp_decoder(pipe, CFG, 5, 7, cache_dtype=jnp.bfloat16)(
+        buf, prompt, jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_pp_decode_sampling_key_stream_matches():
     """temperature + top-k through the pipeline: identical tokens to the
     single-device cached decoder (same one-split-per-token key stream)."""
