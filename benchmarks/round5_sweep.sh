@@ -22,8 +22,11 @@ probe() {
 
 # Patient acquisition: after ANY client exits (including our own probes) the
 # server can take minutes to re-grant the claim, so a single failed probe is
-# not a wedge verdict. Probe every 10 minutes up to a deadline.
-deadline=$(( $(date +%s) + 6*3600 ))
+# not a wedge verdict — and a hard wedge (SIGTERM'd client mid-dispatch) has
+# only ever cleared by server-side expiry ~20 h later. Probe every 15 minutes
+# (sparse, in case killed-at-acquisition probes themselves reset the claim
+# timer) up to a 10 h deadline.
+deadline=$(( $(date +%s) + 10*3600 ))
 n=0
 while true; do
   n=$((n+1))
@@ -33,10 +36,10 @@ while true; do
     break
   fi
   if [ "$(date +%s)" -ge "$deadline" ]; then
-    echo "[r5] 6h deadline reached, tunnel never answered - giving up"
+    echo "[r5] 10h deadline reached, tunnel never answered - giving up"
     exit 17
   fi
-  sleep 600
+  sleep 900
 done
 sleep 60
 
