@@ -15,7 +15,11 @@ the framework's hottest op — causal multi-head attention:
   inner loop land on the 128x128 systolic array;
 - **causal block skipping**: k-blocks wholly past the diagonal are predicated
   off with ``pl.when`` (forward) / a diagonal-bounded loop (backward),
-  halving FLOPs vs masking a full sweep;
+  halving FLOPs vs masking a full sweep — and their HBM fetches are elided
+  too: the block index maps clamp at the diagonal, so skipped iterations
+  revisit the previous block and Mosaic's pipeline issues no copy (without
+  the clamp, K/V traffic is rectangular while the work is triangular, and
+  the waste grows with T);
 - **f32 accumulation** in VMEM scratch regardless of input dtype;
 - backward via ``jax.custom_vjp`` recompute: cotangents re-derive the
   attention weights blockwise from the saved (l, m) softmax statistics —
