@@ -71,26 +71,26 @@ def main():
             # larger bk cuts grid steps.
             for bq, bk in ((128, 128), (256, 256), (256, 512),
                            (512, 256), (512, 512), (512, 1024)):
-                    if bq > t or bk > t:
-                        continue
-                    attn = functools.partial(flash_attention,
-                                             block_q=bq, block_k=bk)
-                    try:
-                        ms = _time(jax.jit(functools.partial(fwd_bwd, attn)),
-                                   q, k, v)
-                        print(json.dumps({
-                            "t": t, "dh": dh, "bq": bq, "bk": bk,
-                            "flash_ms": round(ms, 3),
-                            "dense_ms": (round(dense_ms, 3)
-                                         if dense_ms is not None else None),
-                            "dense_oom": dense_oom,
-                            "speedup": (round(dense_ms / ms, 2)
-                                        if dense_ms is not None else None)}))
-                    except Exception as e:
-                        print(json.dumps({"t": t, "dh": dh, "bq": bq,
-                                          "bk": bk,
-                                          "err": str(e)[:120]}))
-                    sys.stdout.flush()
+                if bq > t or bk > t:
+                    continue
+                attn = functools.partial(flash_attention,
+                                         block_q=bq, block_k=bk)
+                try:
+                    ms = _time(jax.jit(functools.partial(fwd_bwd, attn)),
+                               q, k, v)
+                    print(json.dumps({
+                        "t": t, "dh": dh, "bq": bq, "bk": bk,
+                        "flash_ms": round(ms, 3),
+                        "dense_ms": (round(dense_ms, 3)
+                                     if dense_ms is not None else None),
+                        "dense_oom": dense_oom,
+                        "speedup": (round(dense_ms / ms, 2)
+                                    if dense_ms is not None else None)}))
+                except Exception as e:
+                    print(json.dumps({"t": t, "dh": dh, "bq": bq,
+                                      "bk": bk,
+                                      "err": str(e)[:120]}))
+                sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -27,6 +27,7 @@ from jax import lax
 
 from simple_distributed_machine_learning_tpu.models.gpt import (
     GPTConfig,
+    _cache_dtype,
     _dense_block_prefill,
     _dense_block_step,
     _head_logprobs,
@@ -55,7 +56,7 @@ def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
     H, d = cfg.n_heads, cfg.d_model
     dh = d // H
     V = cfg.vocab
-    cd = jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
+    cd = _cache_dtype(cache_dtype)
 
     @jax.jit
     def decode(params, prompt, key):

@@ -378,6 +378,15 @@ def _dense_attn_tail(bp, h, a):
     return h + linear(bp["mlp_out"], jax.nn.gelu(linear(bp["mlp_in"], hn2)))
 
 
+def _cache_dtype(cache_dtype):
+    """K/V cache storage dtype (None = f32). bf16 HALVES decode memory — the
+    cache is the dominant inference allocation at L x B x H x total x dh x 2
+    buffers — at ~1e-3 relative logit error (attention math still
+    accumulates in f32 via einsum promotion). The one copy of the rule for
+    every decoder (cached, beam, pipeline-parallel)."""
+    return jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
+
+
 def _dense_block_prefill(bp, h, li, kc, vc, prompt_len, n_heads):
     """One block over the whole prompt [b, T0, d], recording cache row
     ``li`` for positions [0, prompt_len). K/V are cast to the cache's dtype
@@ -581,11 +590,7 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
                                    "make_cached_decoder")
     H, d = cfg.n_heads, cfg.d_model
     dh = d // H
-    # cache_dtype: K/V cache storage dtype (None = f32). bf16 HALVES decode
-    # memory — the cache is the dominant inference allocation at
-    # L x B x H x total x dh x 2 buffers — at ~1e-3 relative logit error
-    # (attention math still accumulates in f32 via einsum promotion).
-    cd = jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
+    cd = _cache_dtype(cache_dtype)
 
     _merged = _merged_stage_trees
     _head_row = _head_logprobs

@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from simple_distributed_machine_learning_tpu.models.gpt import (
     GPTConfig,
+    _cache_dtype,
     _check_sampling_args,
     _dense_block_prefill,
     _dense_block_step,
@@ -106,7 +107,7 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
     fwd = [(i, (i + 1) % S) for i in range(S)]
 
     # cache_dtype: as make_cached_decoder (bf16 halves each stage's cache)
-    cd = jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
+    cd = _cache_dtype(cache_dtype)
 
     def per_device(row4d, prompt, key):
         row = row4d[0, 0, 0]

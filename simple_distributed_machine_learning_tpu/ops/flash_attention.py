@@ -55,6 +55,17 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _diag_kv_index(block_q: int, block_k: int):
+    """Index map for K/V blocks on a (bh, q-block, k-block) grid, clamped at
+    the causal diagonal: k-blocks wholly past the diagonal revisit the last
+    needed block, so Mosaic's pipeline elides their HBM fetch (no copy when
+    the block index is unchanged between iterations). One copy of the clamp
+    arithmetic for the forward and dq passes."""
+    def idx(i, j, kb):
+        return (i, jnp.minimum(kb, ((j + 1) * block_q - 1) // block_k), 0)
+    return idx
+
+
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
     size = x.shape[axis]
     rem = (-size) % mult
@@ -155,16 +166,13 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
     compiler_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
-    # Causal fetch elision: the kernel predicates off compute for k-blocks
-    # wholly past the diagonal, but an unclamped index map would still FETCH
-    # those blocks from HBM every iteration — rectangular K/V traffic for
-    # triangular work, and the traffic grows with T (the r4 "flash trails
-    # dense more the longer the sequence" signature). Clamping the k index
-    # at the last needed block makes consecutive skipped iterations revisit
-    # the same block, which Mosaic's pipeline elides (no copy when the block
-    # index is unchanged) — K/V HBM reads drop ~2x for causal.
-    def _kv_idx(i, j, kb):
-        return (i, jnp.minimum(kb, ((j + 1) * block_q - 1) // block_k), 0)
+    # Causal fetch elision (_diag_kv_index): the kernel predicates off
+    # compute for k-blocks past the diagonal, but an unclamped index map
+    # would still FETCH those blocks from HBM every iteration — rectangular
+    # K/V traffic for triangular work, growing with T (the r4 "flash trails
+    # dense more the longer the sequence" signature). The clamp cuts K/V
+    # HBM reads ~2x for causal.
+    _kv_idx = _diag_kv_index(block_q, block_k)
 
     o, l, m = pl.pallas_call(
         kernel,
@@ -361,10 +369,7 @@ def _flash_bwd(block_q, block_k, res, do):
     q_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0))
     # clamp past-diagonal k fetches to the last needed block (same causal
     # fetch elision as the forward — skipped cells must not cost HBM reads)
-    k_spec = pl.BlockSpec(
-        (1, block_k, dp_),
-        lambda i, j, kb: (i, jnp.minimum(kb, ((j + 1) * block_q - 1)
-                                         // block_k), 0))
+    k_spec = pl.BlockSpec((1, block_k, dp_), _diag_kv_index(block_q, block_k))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j))
     compiler_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
