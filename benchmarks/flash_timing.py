@@ -144,9 +144,10 @@ def main() -> None:
                "device": jax.devices()[0].device_kind}
         rows.append(row)
         print(json.dumps(row))
-
-    with open(OUT, "w") as f:
-        json.dump(rows, f, indent=2)
+        # rewrite after every row: a late-row failure or a step timeout on
+        # flaky hardware must not cost the rows already measured
+        with open(OUT, "w") as f:
+            json.dump(rows, f, indent=2)
 
 
 if __name__ == "__main__":
