@@ -572,6 +572,51 @@ def main() -> None:
     if args.decode and not args.all:
         _run_decode()
     rows = []
+
+    def _write_results(partial: bool) -> None:
+        # the authoritative GPipe artifact — a 1f1b sweep writes its own
+        # file instead of silently overwriting it with rows that used to be
+        # indistinguishable. Both the filename and the top-level field
+        # reflect what actually RAN, not what was requested: on one chip a
+        # --schedule 1f1b sweep degenerates to gpipe rows (measure()'s
+        # n_stages < 2 fallback) and is recorded as such. Written after
+        # EVERY row (partial=True) so a late-row failure on flaky hardware
+        # cannot cost the rows already measured.
+        if not rows:
+            return
+        ran = {r["schedule"] for r in rows}
+        sched_actual = ran.pop() if len(ran) == 1 else "mixed"
+        if not partial and sched_actual != args.schedule:
+            sys.stderr.write(
+                f"bench: requested --schedule {args.schedule} but rows ran "
+                f"{sched_actual} (single-chip fallback?); recording "
+                f"{sched_actual}\n")
+        path = (RESULTS_PATH if sched_actual == "gpipe" else
+                RESULTS_PATH.replace(".json", f"_{sched_actual}.json"))
+        # never let a CPU-backend sweep silently clobber the authoritative
+        # TPU artifact (easy to do from a dev shell with JAX_PLATFORMS=cpu)
+        if rows[0]["backend"] != "tpu" and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    prev = json.load(f)
+            except Exception:
+                prev = {}
+            if prev.get("backend") == "tpu":
+                path = path.replace(".json", f"_{rows[0]['backend']}.json")
+                if partial is False:
+                    sys.stderr.write(
+                        f"bench: existing artifact is from TPU; this "
+                        f"{rows[0]['backend']} sweep written to {path}\n")
+        payload = {"device": rows[0]["device_kind"],
+                   "backend": rows[0]["backend"],
+                   "schedule": sched_actual,
+                   "rows": rows}
+        if partial:
+            payload["partial"] = True
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+
+    write_artifact = args.all and args.opt is None and args.lr is None
     for name in names:
         spec = (dict(configs[name], steps_override=args.steps)
                 if args.steps else configs[name])
@@ -601,35 +646,18 @@ def main() -> None:
             "schedule": res["schedule"],
             "optimizer": res["optimizer"],
         }))
+        if write_artifact:
+            _write_results(partial=True)
     if args.all:
         # decode runs AFTER the train table so a decode failure can never
         # cost the sweep its main payload
         _run_decode()
-    if args.all and (args.opt is not None or args.lr is not None):
+    if args.all and not write_artifact:
         sys.stderr.write(
             "bench: --opt/--lr override active - results_all.json NOT "
             "rewritten (experiment rows only)\n")
-    elif args.all:
-        # results_all.json is the authoritative GPipe artifact — a 1f1b sweep
-        # writes its own file instead of silently overwriting it with rows
-        # that used to be indistinguishable. Both the filename and the
-        # top-level field reflect what actually RAN, not what was requested:
-        # on one chip a --schedule 1f1b sweep degenerates to gpipe rows
-        # (measure()'s n_stages < 2 fallback) and is recorded as such
-        ran = {r["schedule"] for r in rows}
-        sched_actual = ran.pop() if len(ran) == 1 else "mixed"
-        if sched_actual != args.schedule:
-            sys.stderr.write(
-                f"bench: requested --schedule {args.schedule} but rows ran "
-                f"{sched_actual} (single-chip fallback?); recording "
-                f"{sched_actual}\n")
-        path = (RESULTS_PATH if sched_actual == "gpipe" else
-                RESULTS_PATH.replace(".json", f"_{sched_actual}.json"))
-        with open(path, "w") as f:
-            json.dump({"device": rows[0]["device_kind"],
-                       "backend": rows[0]["backend"],
-                       "schedule": sched_actual,
-                       "rows": rows}, f, indent=2)
+    elif write_artifact:
+        _write_results(partial=False)
 
 
 if __name__ == "__main__":
