@@ -1,0 +1,82 @@
+"""Distill the flash measurement artifacts into the default-policy decision.
+
+Reads benchmarks/flash_timing.json (fixed-block rows + the jaxref ceiling
+column) and benchmarks/flash_tune.log (block-sweep JSON lines) and prints:
+per (T, dh): dense ms, best flash (blocks, ms, speedup), jaxref speedup.
+Exit status: 0 if any flash row reaches >= 1.0x dense, 3 otherwise — the
+"win exists / keep dense default" bit (BASELINE.md flash policy).
+
+CPU-safe: reads artifacts only, never creates a device client.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> int:
+    points: dict[tuple[int, int], dict] = {}
+
+    path = os.path.join(HERE, "flash_timing.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            for r in json.load(f):
+                if "flash_ms" not in r:
+                    continue
+                p = points.setdefault((r["t"], r["dh"], r["dtype"]),
+                                      {"cands": []})
+                if r.get("dense_ms") is not None:
+                    p["dense_ms"] = r["dense_ms"]
+                p["cands"].append(("128/128(timing)", r["flash_ms"]))
+                if r.get("jaxref_ms") is not None:
+                    p["jaxref_ms"] = r["jaxref_ms"]
+
+    path = os.path.join(HERE, "flash_tune.log")
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "flash_ms" not in r:
+                    continue
+                p = points.setdefault((r["t"], r["dh"], "bfloat16"),
+                                      {"cands": []})
+                if r.get("dense_ms") is not None:
+                    p.setdefault("dense_ms", r["dense_ms"])
+                p["cands"].append((f"{r['bq']}/{r['bk']}", r["flash_ms"]))
+
+    if not points:
+        print("no flash artifacts found")
+        return 3
+
+    any_win = False
+    print(f"{'T':>6} {'dh':>4} {'dtype':>9} {'dense ms':>9} "
+          f"{'best flash':>16} {'speedup':>8} {'jaxref x':>9}")
+    for (t, dh, dtype), p in sorted(points.items()):
+        blocks, ms = min(p["cands"], key=lambda c: c[1])
+        dense = p.get("dense_ms")
+        speed = dense / ms if dense else None
+        jref = (dense / p["jaxref_ms"]
+                if dense and p.get("jaxref_ms") else None)
+        if speed is not None and speed >= 1.0:
+            any_win = True
+        print(f"{t:>6} {dh:>4} {dtype:>9} "
+              f"{dense if dense is not None else '--':>9} "
+              f"{blocks + ' ' + format(ms, '.2f'):>16} "
+              f"{format(speed, '.2f') if speed else '--':>8} "
+              f"{format(jref, '.2f') if jref else '--':>9}")
+    print("verdict:", "flash >= 1x exists" if any_win
+          else "dense wins everywhere measured")
+    return 0 if any_win else 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
