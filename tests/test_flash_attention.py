@@ -15,6 +15,7 @@ from simple_distributed_machine_learning_tpu.ops.attention import (
     mha_init,
 )
 from simple_distributed_machine_learning_tpu.ops.flash_attention import (
+    _diag_kv_index,
     flash_attention,
     flash_mha,
 )
@@ -22,6 +23,24 @@ from simple_distributed_machine_learning_tpu.ops.flash_attention import (
 # the canonical masked-softmax math from ops/attention.py — the kernel is
 # verified against the same code every other attention path uses
 _dense_reference = causal_attention_core
+
+
+@pytest.mark.parametrize("bq,bk", [(128, 128), (256, 128), (128, 256),
+                                   (512, 1024), (96, 64)])
+def test_diag_kv_index_clamp(bq, bk):
+    """The causal fetch-elision index map: for q-block j the LAST needed
+    k-block covers position j*bq + bq - 1, and every kb beyond it must clamp
+    there (same index as the previous iteration ⇒ Mosaic elides the fetch);
+    every kb at or before it must pass through unchanged."""
+    idx = _diag_kv_index(bq, bk)
+    for j in range(6):
+        last_needed = (((j + 1) * bq) - 1) // bk
+        for kb in range(12):
+            i_, got, z = idx(7, j, kb)
+            assert (i_, z) == (7, 0)
+            assert int(got) == min(kb, last_needed)
+        # the block holding the diagonal position is always fetchable
+        assert int(idx(0, j, last_needed)[1]) == last_needed
 
 
 @pytest.mark.parametrize("t,dh,bq,bk", [
