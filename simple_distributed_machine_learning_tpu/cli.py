@@ -151,6 +151,11 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--remat', action='store_true',
                    help="rematerialize stage activations in backward "
                         "(jax.checkpoint): trades FLOPs for memory")
+    g.add_argument('--metrics-json', type=str, default=None, metavar='PATH',
+                   help='append one JSON line of metrics per epoch (epoch, '
+                        'step, train_loss, samples_per_sec, eval_loss, '
+                        'accuracy) — the machine-readable counterpart of '
+                        'the reference-format console output')
     g.add_argument('--profile', type=str, default=None, metavar='DIR',
                    help="capture an XProf/TensorBoard trace of the whole run "
                         "into DIR")
@@ -293,7 +298,8 @@ def _dispatch(args) -> None:
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
                          resume=not args.no_resume, zero1=args.zero1,
                          async_checkpoint=args.async_checkpoint,
-                         shuffle=args.shuffle)
+                         shuffle=args.shuffle,
+                         metrics_json=args.metrics_json)
     _fit(args, Trainer(pipe, train_ds, test_ds, config,
                        opt=_make_opt(args, _total_steps(args, train_ds),
                                      pipe)))
@@ -400,7 +406,8 @@ def _run_gpt(args, n_stages: int, key) -> None:
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
                          resume=not args.no_resume, zero1=args.zero1,
                          async_checkpoint=args.async_checkpoint,
-                         shuffle=args.shuffle)
+                         shuffle=args.shuffle,
+                         metrics_json=args.metrics_json)
     trainer = Trainer(pipe, train_ds, test_ds, config,
                       opt=_make_opt(args, _total_steps(args, train_ds),
                                     pipe))

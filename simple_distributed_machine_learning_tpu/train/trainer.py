@@ -73,6 +73,11 @@ class TrainConfig:
     # batch on every host (data/sharding.py). On a single process this is a
     # no-op path and the plain numpy feed is used.
     shard_inputs: bool = True
+    # machine-readable training log: one JSON line per epoch (epoch, step,
+    # train_loss, samples_per_sec, eval_loss, accuracy) appended to this
+    # path by process 0. The console surface stays byte-identical to the
+    # reference; this is the structured counterpart (SURVEY §5.5).
+    metrics_json: str | None = None
 
 
 class Trainer:
@@ -95,6 +100,7 @@ class Trainer:
         self._eval_step = make_eval_step(pipe)
         self._key = jax.random.key(self.config.seed)
         self._step_count = 0
+        self._last_samples_per_sec = 0.0
         self._pending_save = None
         self.start_epoch = 1
         self.is_main = jax.process_index() == 0
@@ -138,7 +144,11 @@ class Trainer:
             raise ValueError(
                 f"checkpoint {path} does not match the model: packed param "
                 f"buffer is {tuple(st['params'].shape)}, model expects "
-                f"{tuple(self.buf.shape)} (different model/topology config?)")
+                f"{tuple(self.buf.shape)} (different model/topology "
+                f"config?). A checkpoint from a different contiguous stage "
+                f"split of the SAME model can be rewritten with "
+                f"train.checkpoint.repack_checkpoint (or restored with "
+                f"restore_checkpoint(..., src_pipe=<source pipeline>)).")
         self.buf, self.opt_state = st["params"], st["opt_state"]
         self._step_count = st["step"]
         self.start_epoch = int(st["extra"].get("epoch", 0)) + 1
@@ -242,8 +252,9 @@ class Trainer:
                     'Train Epoch: {} [{}/{} ({:.0f}%)]\tLoss: {:.6f}'.format(
                         epoch, batch_idx * len(b.x), n_total,
                         100.0 * batch_idx / n_batches, float(loss)))
+        jax.block_until_ready(self.buf)      # drain async-dispatched steps
+        self._last_samples_per_sec = meter.samples_per_sec
         if cfg.print_throughput:
-            jax.block_until_ready(self.buf)  # drain async-dispatched steps
             self._print('| epoch {}: {:.1f} samples/sec'.format(
                 epoch, meter.samples_per_sec))
         return float(loss)
@@ -267,12 +278,29 @@ class Trainer:
             .format(avg, correct, n, 100.0 * correct / n))
         return avg, correct
 
+    def _log_metrics(self, record: dict) -> None:
+        if not (self.config.metrics_json and self.is_main):
+            return
+        import json
+        with open(self.config.metrics_json, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
     def fit(self) -> None:
         """The reference's epoch driver (``simple_distributed.py:134-136``),
-        plus per-epoch checkpointing when ``checkpoint_dir`` is set."""
+        plus per-epoch checkpointing when ``checkpoint_dir`` is set and a
+        JSONL metrics record per epoch when ``metrics_json`` is set."""
         for epoch in range(self.start_epoch, self.config.epochs + 1):
-            self.train_epoch(epoch)
-            self.evaluate()
+            train_loss = self.train_epoch(epoch)
+            eval_loss, correct = self.evaluate()
+            self._log_metrics({
+                "epoch": epoch,
+                "step": self._step_count,
+                "train_loss": round(train_loss, 6),
+                "samples_per_sec": round(self._last_samples_per_sec, 1),
+                "eval_loss": round(eval_loss, 6),
+                "correct": correct,
+                "n_eval": int(self.test_ds.y.size),
+            })
             self._save(epoch)
         if self._pending_save is not None:
             self._pending_save.wait()

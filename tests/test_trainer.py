@@ -92,3 +92,33 @@ def test_trainer_checkpoint_resume(tmp_path):
 
     t3 = build(epochs=3)
     assert t3.start_epoch == 4      # nothing left to do
+
+
+def test_metrics_json_records_per_epoch(tmp_path):
+    """metrics_json appends one well-formed JSON line per epoch with the
+    documented keys — the machine-readable counterpart of the console
+    surface (SURVEY §5.5)."""
+    import json
+
+    train, test = synthetic_mnist(n_train=120, n_test=60, seed=5)
+    stages, wire_dim, out_dim = make_mlp_stages(jax.random.key(0),
+                                                [784, 32, 10], 2)
+    ds_tr = Dataset(train.x.reshape(len(train.x), -1), train.y)
+    ds_te = Dataset(test.x.reshape(len(test.x), -1), test.y)
+    pipe = Pipeline(stages, make_mesh(n_stages=2, n_data=1),
+                    wire_dim, out_dim)
+    path = tmp_path / "metrics.jsonl"
+    cfg = TrainConfig(epochs=3, batch_size=60, print_throughput=False,
+                      metrics_json=str(path))
+    Trainer(pipe, ds_tr, ds_te, cfg).fit()
+
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["epoch"] for r in records] == [1, 2, 3]
+    for r in records:
+        assert set(r) == {"epoch", "step", "train_loss", "samples_per_sec",
+                          "eval_loss", "correct", "n_eval"}
+        assert r["n_eval"] == 60
+        assert 0 <= r["correct"] <= 60
+        assert r["samples_per_sec"] >= 0.0
+    # steps accumulate across epochs (2 batches/epoch here)
+    assert records[-1]["step"] == 6
