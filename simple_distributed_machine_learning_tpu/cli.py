@@ -118,6 +118,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--async-checkpoint', action='store_true',
                    help="overlap the checkpoint file write with the next "
                         "epoch (the sharded gather stays synchronous)")
+    g.add_argument('--eval-only', action='store_true',
+                   help="skip training: evaluate the checkpoint-restored "
+                        "(or fresh-initialized) params on the test set and "
+                        "exit")
     g.add_argument('--experts', type=int, default=0,
                    help="for --model=gpt: replace each block's MLP with a "
                         "top-2-routed mixture of this many experts (0 = dense)")
@@ -344,6 +348,14 @@ def _total_steps(args, train_ds) -> int:
 
 
 def _fit(args, trainer) -> None:
+    if args.eval_only:
+        # evaluate the restored (or fresh-init, if no checkpoint) params
+        # without training — the companion to --checkpoint-dir resume
+        if args.checkpoint_dir and trainer.start_epoch == 1:
+            trainer._print("| --eval-only: no checkpoint found, evaluating "
+                           "fresh-initialized params")
+        trainer.evaluate()
+        return
     if args.profile:
         from simple_distributed_machine_learning_tpu.utils.profiler import trace
         with trace(args.profile):

@@ -96,3 +96,25 @@ def test_cli_generate_prints_sample(tmp_path, capsys):
 def test_cli_generate_requires_gpt():
     with pytest.raises(SystemExit, match="--generate is only supported"):
         main(["--rank", "0", "--model", "mlp", "--generate", "8"])
+
+
+def test_cli_eval_only_from_checkpoint(tmp_path, capsys):
+    """--eval-only restores the checkpoint and evaluates without training:
+    accuracy matches the end of the training run, and no train lines print."""
+    import re
+
+    from simple_distributed_machine_learning_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--stages", "2", "--epochs", "2", "--microbatches", "2",
+          "--checkpoint-dir", ckpt])
+    trained = capsys.readouterr().out
+    acc_trained = re.findall(r"Accuracy: (\d+)/", trained)[-1]
+
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--stages", "2", "--epochs", "2", "--microbatches", "2",
+          "--checkpoint-dir", ckpt, "--eval-only"])
+    out = capsys.readouterr().out
+    assert "Train Epoch" not in out
+    assert re.findall(r"Accuracy: (\d+)/", out)[-1] == acc_trained
