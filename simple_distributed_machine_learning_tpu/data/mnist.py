@@ -189,23 +189,22 @@ def prefetch_batches(ds: Dataset, batch_size: int,
     the TPU-side analogue of the torch DataLoader worker the reference leans
     on (SURVEY §2.3). Falls back to the pure-Python iterator transparently.
 
-    ``shuffle_seed``: seeded epoch shuffle; the permutation is applied to the
-    (host-resident) arrays up front so the native prefetcher still streams
-    contiguous slices. NOTE: this materializes a full shuffled COPY of the
-    dataset each epoch — free at MNIST scale, but for datasets where 2x host
-    residency matters, prefer the index-based Python iterator
-    (:func:`batches`), which gathers per batch instead.
+    ``shuffle_seed``: seeded epoch shuffle. The permutation is handed to the
+    native prefetcher as its gather order (it assembles batches by index on
+    its own thread), so no shuffled copy of the dataset is ever
+    materialized; the Python fallback (:func:`batches`) gathers per batch
+    with the identical permutation RNG.
     """
     from simple_distributed_machine_learning_tpu.data import native_loader
 
-    if shuffle_seed is not None:
-        order = np.random.RandomState(
-            shuffle_seed % 2**32).permutation(len(ds.x))
-        ds = Dataset(ds.x[order], ds.y[order])
     if not native_loader.available():
-        yield from batches(ds, batch_size, pad_last=True)
+        yield from batches(ds, batch_size, pad_last=True,
+                           shuffle_seed=shuffle_seed)
         return
-    pf = native_loader.NativePrefetcher(ds.x, ds.y, batch_size)
+    order = (np.random.RandomState(
+                 shuffle_seed % 2**32).permutation(len(ds.x))
+             if shuffle_seed is not None else None)
+    pf = native_loader.NativePrefetcher(ds.x, ds.y, batch_size, order=order)
     try:
         for bx, by, n_valid in pf:
             yield Batch(bx, by.astype(ds.y.dtype, copy=False), n_valid)
