@@ -149,6 +149,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attention implementation for --model=gpt (flash = "
                         "Pallas fused kernel; ring/ulysses = sequence-"
                         "parallel collectives, used with --sp)")
+    g.add_argument('--flash-blocks', type=str, default=None, metavar='Q,K',
+                   help="with --attn flash: kernel block sizes, e.g. "
+                        "512,512 (defaults 128,128; tune with "
+                        "benchmarks/flash_tune.py)")
     g.add_argument('--bf16', action='store_true',
                    help="bfloat16 compute (float32 master params and loss): "
                         "doubles MXU throughput, halves HBM traffic")
@@ -382,11 +386,22 @@ def _run_gpt(args, n_stages: int, key) -> None:
         Trainer,
     )
 
+    fb = {}
+    if args.flash_blocks:
+        if args.attn != "flash":
+            raise SystemExit("--flash-blocks needs --attn flash")
+        try:
+            bq, bk = (int(v) for v in args.flash_blocks.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--flash-blocks expects Q,K integers, got "
+                f"{args.flash_blocks!r}") from None
+        fb = {"flash_block_q": bq, "flash_block_k": bk}
     cfg = GPTConfig(vocab=256 if args.text_corpus else 128,
                     n_experts=args.experts,
                     moe_top_k=min(2, max(1, args.experts)),
                     attn_impl=args.attn, n_seq=args.sp,
-                    n_expert_parallel=args.ep)
+                    n_expert_parallel=args.ep, **fb)
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
     def as_ds(x, y):
         return Dataset(x.astype(np.float32), y)

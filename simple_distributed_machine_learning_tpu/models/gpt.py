@@ -62,6 +62,11 @@ class GPTConfig:
     #   "ulysses" — DeepSpeed-Ulysses all-to-all head/sequence re-sharding
     #               (parallel/sequence.py); n_heads must divide by n_seq
     attn_impl: str = "dense"
+    # Pallas flash kernel block sizes (attn_impl="flash" only): the tuned
+    # values from benchmarks/flash_tune.py go here — bigger block_q cuts K/V
+    # HBM passes, bigger block_k cuts grid steps (VMEM bounds both)
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     # sequence parallelism: n_seq > 1 shards the token axis over the mesh's
     # "seq" axis — stage in_shapes, the wire, and all block compute are then
     # per-shard (seq_len / n_seq tokens); cross-token mixing happens only in
@@ -89,6 +94,10 @@ class GPTConfig:
             raise ValueError(
                 f"attn_impl must be one of dense/flash/ring/ulysses, got "
                 f"{self.attn_impl!r}")
+        if self.flash_block_q < 1 or self.flash_block_k < 1:
+            raise ValueError(
+                f"flash blocks must be positive, got "
+                f"{self.flash_block_q}/{self.flash_block_k}")
         if self.n_seq < 1 or self.seq_len % self.n_seq:
             raise ValueError(
                 f"seq_len {self.seq_len} not divisible by n_seq {self.n_seq}")
@@ -144,7 +153,8 @@ def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
         from simple_distributed_machine_learning_tpu.ops.flash_attention import (
             flash_mha,
         )
-        a = flash_mha(params["attn"], hn1, cfg.n_heads)
+        a = flash_mha(params["attn"], hn1, cfg.n_heads,
+                      block_q=cfg.flash_block_q, block_k=cfg.flash_block_k)
     elif cfg.attn_impl == "ring" and cfg.n_seq > 1:
         from simple_distributed_machine_learning_tpu.ops.attention import (
             SEQ_AXIS,

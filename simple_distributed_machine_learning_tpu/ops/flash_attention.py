@@ -55,6 +55,20 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _vma_of(*xs) -> frozenset:
+    """Union of the operands' varying-manual-axes (vma) sets.
+
+    Under ``shard_map(..., check_vma=True)`` — how every pipeline engine
+    here runs — ``pallas_call`` out_shape structs must declare how outputs
+    vary over the manual mesh axes, or tracing fails; the kernel's outputs
+    vary exactly as its operands do. Outside shard_map this is the empty
+    set and changes nothing."""
+    vma = frozenset()
+    for x in xs:
+        vma |= getattr(jax.typeof(x), "vma", None) or frozenset()
+    return vma
+
+
 def _diag_kv_index(block_q: int, block_k: int):
     """Index map for K/V blocks on a (bh, q-block, k-block) grid, clamped at
     the causal diagonal: k-blocks wholly past the diagonal revisit the last
@@ -173,6 +187,7 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
     # dense more the longer the sequence" signature). The clamp cuts K/V
     # HBM reads ~2x for causal.
     _kv_idx = _diag_kv_index(block_q, block_k)
+    vma = _vma_of(qp, kp, vp)
 
     o, l, m = pl.pallas_call(
         kernel,
@@ -188,9 +203,9 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
             pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, dp), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
-            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, dp), jnp.float32),
@@ -365,6 +380,7 @@ def _flash_bwd(block_q, block_k, res, do):
     linvp = _rows_3d(linvp, bh, tq)
     dlp = _rows_3d(dlp, bh, tq)
     n_qb, n_kb = tq // block_q, tk // block_k
+    vma = _vma_of(qp, kp, vp, dop, mp, linvp, dlp)
 
     q_spec = pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0))
     # clamp past-diagonal k fetches to the last needed block (same causal
@@ -381,7 +397,7 @@ def _flash_bwd(block_q, block_k, res, do):
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
                   row_spec],
         out_specs=pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, dp_), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, dp_), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, dp_), jnp.float32)],
         compiler_params=compiler_params,
         interpret=_interpret(),
@@ -410,8 +426,8 @@ def _flash_bwd(block_q, block_k, res, do):
             pl.BlockSpec((1, block_k, dp_), lambda i, j, qb: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, dp_), k.dtype),
-            jax.ShapeDtypeStruct((bh, tk, dp_), v.dtype),
+            jax.ShapeDtypeStruct((bh, tk, dp_), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, tk, dp_), v.dtype, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, dp_), jnp.float32),

@@ -216,3 +216,44 @@ def test_gpt_flash_matches_dense_stages():
                                 jax.random.key(0), True)
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_flash_runs_in_sharded_pipeline():
+    """attn_impl='flash' inside the REAL shard_map pipeline engine
+    (check_vma on): regression for the missing vma declaration on the
+    pallas_call out_shape structs, which made every --attn flash pipeline
+    run fail to trace. One train step must match the dense build exactly
+    (flash is the same math; f32, tiny T)."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+    from simple_distributed_machine_learning_tpu.train.optimizer import sgd
+    from simple_distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+    )
+
+    kw = dict(vocab=32, seq_len=16, d_model=32, n_heads=2, n_layers=2)
+    x = jax.random.randint(jax.random.key(1), (8, 16), 0, 32).astype(
+        jnp.float32)
+    y = jax.random.randint(jax.random.key(2), (8, 16), 0, 32)
+    opt = sgd(0.1, 0.5)
+
+    def one_step(cfg):
+        stages, wd, osh = make_gpt_stages(jax.random.key(0), cfg, 2)
+        pipe = Pipeline(stages, make_mesh(n_stages=2, n_data=1), wd, osh,
+                        n_microbatches=2)
+        buf = pipe.init_params()
+        buf, _, loss = make_train_step(pipe, opt)(
+            buf, opt.init(buf), x, y, jax.random.key(3))
+        return float(loss), np.asarray(buf)
+
+    lf, bf = one_step(GPTConfig(attn_impl="flash", flash_block_q=16,
+                                flash_block_k=16, **kw))
+    ld, bd = one_step(GPTConfig(**kw))
+    np.testing.assert_allclose(lf, ld, rtol=2e-4)
+    np.testing.assert_allclose(bf, bd, rtol=5e-3, atol=5e-4)
