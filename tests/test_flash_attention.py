@@ -218,9 +218,12 @@ def test_gpt_flash_matches_dense_stages():
                                rtol=2e-4, atol=2e-4)
 
 
-def test_gpt_flash_runs_in_sharded_pipeline():
-    """attn_impl='flash' inside the REAL shard_map pipeline engine
-    (check_vma on): regression for the missing vma declaration on the
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_gpt_flash_runs_in_sharded_pipeline(schedule):
+    """attn_impl='flash' inside the REAL shard_map pipeline engines
+    (check_vma on), under BOTH schedules — GPipe's jax.grad-through-scan
+    and 1F1B's hand-scheduled jax.vjp (the kernel's custom_vjp must
+    compose with each). Regression for the missing vma declaration on the
     pallas_call out_shape structs, which made every --attn flash pipeline
     run fail to trace. One train step must match the dense build exactly
     (flash is the same math; f32, tiny T)."""
@@ -246,7 +249,7 @@ def test_gpt_flash_runs_in_sharded_pipeline():
     def one_step(cfg):
         stages, wd, osh = make_gpt_stages(jax.random.key(0), cfg, 2)
         pipe = Pipeline(stages, make_mesh(n_stages=2, n_data=1), wd, osh,
-                        n_microbatches=2)
+                        n_microbatches=2, schedule=schedule)
         buf = pipe.init_params()
         buf, _, loss = make_train_step(pipe, opt)(
             buf, opt.init(buf), x, y, jax.random.key(3))
