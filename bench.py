@@ -269,7 +269,12 @@ def measure(name: str, spec: dict, windows: int = 5,
         from simple_distributed_machine_learning_tpu.models.gpt import (
             make_gpt_stages,
         )
+        import dataclasses as _dc
         cfg = spec["cfg"]
+        if spec.get("attn"):
+            fb = spec.get("flash_blocks") or (128, 128)
+            cfg = _dc.replace(cfg, attn_impl=spec["attn"],
+                              flash_block_q=fb[0], flash_block_k=fb[1])
         n_stages = 2 if n_dev >= 2 else 1
         stages, wire_dim, out_dim = make_gpt_stages(jax.random.key(0), cfg,
                                                     n_stages)
@@ -339,6 +344,8 @@ def measure(name: str, spec: dict, windows: int = 5,
         "lr": (spec["lr"] if spec.get("lr") is not None
                else (1e-3 if spec.get("opt") == "adamw" else 0.1)),
         "schedule": sched,
+        "attn": (spec.get("attn", "dense") if spec["kind"] == "gpt"
+                 else None),
         "final_loss": round(final_loss, 4),
     }
 
@@ -513,6 +520,12 @@ def main() -> None:
                     help="override the per-config optimizer (experiment "
                          "rows only; results_all.json is not rewritten "
                          "under an override)")
+    ap.add_argument("--attn", choices=("dense", "flash"), default=None,
+                    help="override the GPT rows' attention implementation "
+                         "(whole-model flash-vs-dense comparison; "
+                         "experiment rows only, like --opt)")
+    ap.add_argument("--flash-blocks", type=str, default=None, metavar="Q,K",
+                    help="with --attn flash: kernel block sizes")
     ap.add_argument("--lr", type=float, default=None,
                     help="override the optimizer learning rate (with "
                          "--opt sgd keeps momentum=0.5; experiment rows "
@@ -616,16 +629,23 @@ def main() -> None:
         with open(path, "w") as f:
             json.dump(payload, f, indent=2)
 
-    write_artifact = args.all and args.opt is None and args.lr is None
+    write_artifact = (args.all and args.opt is None and args.lr is None
+                      and args.attn is None)
     for name in names:
         spec = (dict(configs[name], steps_override=args.steps)
                 if args.steps else configs[name])
-        if args.opt is not None or args.lr is not None:
+        if (args.opt is not None or args.lr is not None
+                or args.attn is not None):
             spec = dict(spec)
             if args.opt is not None:
                 spec["opt"] = args.opt
             if args.lr is not None:
                 spec["lr"] = args.lr
+            if args.attn is not None and spec["kind"] == "gpt":
+                spec["attn"] = args.attn
+                if args.flash_blocks:
+                    spec["flash_blocks"] = tuple(
+                        int(v) for v in args.flash_blocks.split(","))
         res = measure(name, spec, schedule=args.schedule)
         # vs_baseline only for the headline: the torch-RPC baseline runs the
         # 2-stage MLP workload, not the others
