@@ -70,9 +70,14 @@ echo "[r5] 3/4 flash_timing (incl. jaxref column) $(date -u +%H:%M:%S)"
 timeout 2400 python benchmarks/flash_timing.py || echo "[r5] flash_timing rc=$?"
 settle_probe
 
-echo "[r5] 4/4 flash_tune block sweep $(date -u +%H:%M:%S)"
+echo "[r5] 4/5 flash_tune block sweep $(date -u +%H:%M:%S)"
 timeout 4800 python benchmarks/flash_tune.py > benchmarks/flash_tune.log 2>&1 \
   || echo "[r5] flash_tune rc=$?"
 tail -5 benchmarks/flash_tune.log
+settle_probe
+
+echo "[r5] 5/5 whole-model flash row: gpt_bf16 --attn flash $(date -u +%H:%M:%S)"
+timeout 1800 python bench.py --config gpt_bf16 --attn flash \
+  || echo "[r5] flash row rc=$?"
 
 echo "[r5] done $(date -u +%H:%M:%S)"
