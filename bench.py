@@ -275,18 +275,34 @@ def measure(name: str, spec: dict, windows: int = 5,
             fb = spec.get("flash_blocks") or (128, 128)
             cfg = _dc.replace(cfg, attn_impl=spec["attn"],
                               flash_block_q=fb[0], flash_block_k=fb[1])
-        n_stages = 2 if n_dev >= 2 else 1
+        tp = spec.get("tp") or 1
+        if tp > 1:
+            if tp > n_dev:
+                raise SystemExit(
+                    f"--tp {tp} needs {tp} devices, have {n_dev}")
+            # the TP sweep measures the collective schedule, so the whole
+            # mesh goes to the model axis (one stage). This also keeps the
+            # ring's ppermutes out of divergent lax.switch branches, whose
+            # global collective-permute rendezvous deadlocks on XLA:CPU
+            # smoke runs (on TPU the permutes are independent ICI DMAs)
+            cfg = _dc.replace(cfg, n_tensor_parallel=tp,
+                              overlap=spec.get("overlap") or "none")
+            n_stages = 1
+        else:
+            n_stages = 2 if n_dev >= 2 else 1
         stages, wire_dim, out_dim = make_gpt_stages(jax.random.key(0), cfg,
                                                     n_stages)
         xs, ts = _data_gpt(cfg, batch, POOL)
 
-    mesh = make_mesh(n_stages=n_stages, n_data=1)
+    n_model = (spec.get("tp") or 1) if spec["kind"] == "gpt" else 1
+    mesh = make_mesh(n_stages=n_stages, n_data=1, n_model=n_model)
     dtype = jnp.bfloat16 if spec["dtype"] == "bfloat16" else None
     # 1f1b needs >= 2 stages; on a single chip the pipeline degenerates to
     # the fused path either way
     sched = schedule if n_stages >= 2 else "gpipe"
     pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=n_micro,
-                    compute_dtype=dtype, schedule=sched)
+                    compute_dtype=dtype, schedule=sched,
+                    overlap=spec.get("overlap") or "none")
     buf = pipe.init_params()
     lr = spec.get("lr")
     if spec.get("opt") == "adamw":
@@ -327,17 +343,18 @@ def measure(name: str, spec: dict, windows: int = 5,
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS.get(kind)
     achieved = sps * spec["flops"]     # aggregate FLOP/s across the pipeline
+    n_chips = n_stages * n_model
     return {
         "config": name,
         "samples_per_sec": round(sps, 1),
-        "samples_per_sec_per_chip": round(sps / n_stages, 1),
-        "n_chips": n_stages,
+        "samples_per_sec_per_chip": round(sps / n_chips, 1),
+        "n_chips": n_chips,
         "dtype": spec["dtype"] or "float32",
         "flops_per_sample": spec["flops"],
         "achieved_tflops": round(achieved / 1e12, 2),
         # model-FLOPs utilization of the chips that ran: aggregate FLOP/s
         # over aggregate peak
-        "mfu": round(achieved / (n_stages * peak), 4) if peak else None,
+        "mfu": round(achieved / (n_chips * peak), 4) if peak else None,
         "device_kind": kind,
         "backend": jax.default_backend(),
         "optimizer": spec.get("opt", "sgd"),
@@ -346,6 +363,9 @@ def measure(name: str, spec: dict, windows: int = 5,
         "schedule": sched,
         "attn": (spec.get("attn", "dense") if spec["kind"] == "gpt"
                  else None),
+        "tp": (spec.get("tp") or 1) if spec["kind"] == "gpt" else None,
+        "overlap": ((spec.get("overlap") or "none")
+                    if spec["kind"] == "gpt" else None),
         "final_loss": round(final_loss, 4),
     }
 
@@ -446,9 +466,12 @@ def _measure_jax_cpu_baseline() -> float:
     """Our own pipeline on 2 virtual CPU devices (BASELINE config 1 analog)."""
     code = (
         "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "os.environ['XLA_FLAGS']=os.environ.get('XLA_FLAGS','')"
+        "+' --xla_force_host_platform_device_count=2';"
         "import jax; jax.config.update('jax_platforms','cpu');"
-        "jax.config.update('jax_num_cpu_devices',2);"
         "import sys; sys.path.insert(0, %r);"
+        "from simple_distributed_machine_learning_tpu.parallel.compat "
+        "import set_host_device_count; set_host_device_count(2);"
         "from bench import measure, _configs;"
         "import json; spec = dict(_configs()['mlp2'], steps_override=2000);"
         "print('RESULT'+json.dumps(measure('mlp2', spec, windows=2)))"
@@ -488,7 +511,10 @@ def _apply_env_platform() -> None:
         m = re.search(r"xla_force_host_platform_device_count=(\d+)",
                       os.environ.get("XLA_FLAGS", ""))
         if m and plat == "cpu":
-            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+            from simple_distributed_machine_learning_tpu.parallel.compat import (
+                set_host_device_count,
+            )
+            set_host_device_count(int(m.group(1)))
     except RuntimeError:
         pass
 
@@ -530,7 +556,41 @@ def main() -> None:
                     help="override the optimizer learning rate (with "
                          "--opt sgd keeps momentum=0.5; experiment rows "
                          "only, like --opt)")
+    ap.add_argument("--tp", type=int, default=None,
+                    help="shard the GPT rows' blocks tensor-parallel over "
+                         "this many devices (Megatron QKV/O + MLP; one "
+                         "pipeline stage, the whole mesh to the model "
+                         "axis; experiment rows only, like --opt)")
+    ap.add_argument("--overlap", choices=("none", "ring"), default=None,
+                    help="collective schedule for the GPT rows' tensor-"
+                         "parallel all-reduces: none = monolithic psum, "
+                         "ring = latency-hiding ppermute-chunked collective "
+                         "matmuls (parallel/overlap.py); pair with --tp; "
+                         "experiment rows only, like --opt")
     args = ap.parse_args()
+    # mirror cli.py's validation instead of silently ignoring the flag or
+    # dumping a raw ValueError traceback from the int parse
+    if args.flash_blocks and args.attn != "flash":
+        raise SystemExit("--flash-blocks needs --attn flash")
+    if args.flash_blocks:
+        raw = args.flash_blocks
+        try:
+            bq, bk = (int(v) for v in raw.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--flash-blocks expects Q,K integers, got {raw!r}"
+            ) from None
+        args.flash_blocks = (bq, bk)
+    if args.overlap == "ring" and args.tp is None:
+        args.tp = 2          # smallest sharded row: the ring schedule
+        #                      measures a collective, which needs a shard
+    if args.overlap == "ring" and args.tp < 2:
+        raise SystemExit("--overlap ring needs --tp >= 2 (there is no "
+                         "collective to schedule on an unsharded row)")
+    if args.tp is not None and args.tp < 1:
+        raise SystemExit(f"--tp must be >= 1, got {args.tp}")
+    if (args.tp or args.overlap) and args.config is None and not args.all:
+        args.config = "gpt"  # the TP/overlap axes are GPT-row knobs
 
     if args.measure_baseline or not os.path.exists(BASELINE_PATH):
         baselines = {}
@@ -630,12 +690,14 @@ def main() -> None:
             json.dump(payload, f, indent=2)
 
     write_artifact = (args.all and args.opt is None and args.lr is None
-                      and args.attn is None)
+                      and args.attn is None and args.tp is None
+                      and args.overlap is None)
     for name in names:
         spec = (dict(configs[name], steps_override=args.steps)
                 if args.steps else configs[name])
         if (args.opt is not None or args.lr is not None
-                or args.attn is not None):
+                or args.attn is not None or args.tp is not None
+                or args.overlap is not None):
             spec = dict(spec)
             if args.opt is not None:
                 spec["opt"] = args.opt
@@ -644,8 +706,12 @@ def main() -> None:
             if args.attn is not None and spec["kind"] == "gpt":
                 spec["attn"] = args.attn
                 if args.flash_blocks:
-                    spec["flash_blocks"] = tuple(
-                        int(v) for v in args.flash_blocks.split(","))
+                    spec["flash_blocks"] = args.flash_blocks
+            if spec["kind"] == "gpt":
+                if args.tp is not None:
+                    spec["tp"] = args.tp
+                if args.overlap is not None:
+                    spec["overlap"] = args.overlap
         res = measure(name, spec, schedule=args.schedule)
         # vs_baseline only for the headline: the torch-RPC baseline runs the
         # 2-stage MLP workload, not the others
@@ -665,6 +731,8 @@ def main() -> None:
             "n_chips": res["n_chips"],
             "schedule": res["schedule"],
             "optimizer": res["optimizer"],
+            "tp": res["tp"],
+            "overlap": res["overlap"],
         }))
         if write_artifact:
             _write_results(partial=True)

@@ -5,12 +5,13 @@
 # clients, generous timeouts, never kill a client mid-dispatch.
 #
 # Steps (value order):
-#   1. flash_tune block sweep         -> benchmarks/flash_tune.log
-#   2. flash_timing (jaxref column)   -> benchmarks/flash_timing.json
-#   3. bench --all (AdamW-fixed bf16 rows + fixed decode harness)
+#   1. bench --all (AdamW-fixed bf16 rows + fixed decode harness)
 #                                     -> benchmarks/results_all.json,
 #                                        benchmarks/decode_timing.json
-#   4. bench --config gpt_bf16_xl     -> MXU-stretch MFU row
+#   2. bench --config gpt_bf16_xl     -> MXU-stretch MFU row
+#   3. flash_timing (jaxref column)   -> benchmarks/flash_timing.json
+#   4. flash_tune block sweep         -> benchmarks/flash_tune.log
+#   5. whole-model flash row          -> gpt_bf16 --attn flash
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -58,15 +59,15 @@ settle_probe() {
 # flash_tune sweep runs LAST with the most generous timeout, because a
 # timeout SIGTERM mid-dispatch can wedge the tunnel for hours (BASELINE.md)
 # and must not take the core artifacts down with it.
-echo "[r5] 1/4 bench --all (AdamW-fixed rows + decode) $(date -u +%H:%M:%S)"
+echo "[r5] 1/5 bench --all (AdamW-fixed rows + decode) $(date -u +%H:%M:%S)"
 timeout 3000 python bench.py --all || echo "[r5] bench --all rc=$?"
 settle_probe
 
-echo "[r5] 2/4 bench --config gpt_bf16_xl $(date -u +%H:%M:%S)"
+echo "[r5] 2/5 bench --config gpt_bf16_xl $(date -u +%H:%M:%S)"
 timeout 1800 python bench.py --config gpt_bf16_xl || echo "[r5] xl rc=$?"
 settle_probe
 
-echo "[r5] 3/4 flash_timing (incl. jaxref column) $(date -u +%H:%M:%S)"
+echo "[r5] 3/5 flash_timing (incl. jaxref column) $(date -u +%H:%M:%S)"
 timeout 2400 python benchmarks/flash_timing.py || echo "[r5] flash_timing rc=$?"
 settle_probe
 

@@ -66,10 +66,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel mesh width (batch must divide by "
                         "dp * microbatches)")
     g.add_argument('--tp', type=int, default=1,
-                   help="tensor-parallel width for --model=mlp: each stage "
+                   help="tensor-parallel width: for --model=mlp each stage "
                         "becomes a column->row sharded pair (needs exactly "
                         "2*stages layers in --mlp-dims, hidden widths "
-                        "divisible by tp)")
+                        "divisible by tp); for --model=gpt every block's "
+                        "QKV/O and MLP shard Megatron-style over a 'model' "
+                        "mesh axis (n_heads and 4*d_model divisible by tp)")
+    g.add_argument('--overlap', choices=("none", "ring"), default="none",
+                   help="collective schedule for the tensor-parallel "
+                        "all-reduces and the expert-parallel dispatch: none "
+                        "= monolithic psum/all_to_all (the chip blocks for "
+                        "the whole collective); ring = ppermute-chunked "
+                        "latency-hiding collective matmuls "
+                        "(parallel/overlap.py) — each chunk's ICI hop hides "
+                        "under another chunk's compute, same losses to "
+                        "float tolerance")
     g.add_argument('--epochs', type=int, default=10)
     g.add_argument('--batch-size', type=int, default=60)
     g.add_argument('--lr', type=float, default=0.1)
@@ -162,8 +173,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--metrics-json', type=str, default=None, metavar='PATH',
                    help='append one JSON line of metrics per epoch (epoch, '
                         'step, train_loss, samples_per_sec, eval_loss, '
-                        'accuracy) — the machine-readable counterpart of '
-                        'the reference-format console output')
+                        'accuracy, plus the raw correct/n_eval counts) — '
+                        'the machine-readable counterpart of the '
+                        'reference-format console output')
     g.add_argument('--profile', type=str, default=None, metavar='DIR',
                    help="capture an XProf/TensorBoard trace of the whole run "
                         "into DIR")
@@ -195,7 +207,10 @@ def _apply_env_platform() -> None:
         m = re.search(r"xla_force_host_platform_device_count=(\d+)",
                       os.environ.get("XLA_FLAGS", ""))
         if m and plat == "cpu":
-            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+            from simple_distributed_machine_learning_tpu.parallel.compat import (
+                set_host_device_count,
+            )
+            set_host_device_count(int(m.group(1)))
     except RuntimeError:
         pass  # backends already up: keep whatever exists
 
@@ -247,8 +262,8 @@ def _dispatch(args) -> None:
     n_stages = args.stages if args.stages is not None else (2 if n_dev >= 2 else 1)
 
     key = jax.random.key(args.seed)
-    if args.tp > 1 and args.model != "mlp":
-        raise SystemExit("--tp is only supported with --model=mlp")
+    if args.tp > 1 and args.model not in ("mlp", "gpt"):
+        raise SystemExit("--tp is only supported with --model=mlp or gpt")
     if args.sp > 1 and args.model != "gpt":
         raise SystemExit("--sp is only supported with --model=gpt")
     if args.ep > 1 and (args.model != "gpt" or args.experts < 1):
@@ -270,7 +285,8 @@ def _dispatch(args) -> None:
         )
         dims = [int(d) for d in args.mlp_dims.split(",")]
         stages, wire_dim, out_dim = make_mlp_tp_stages(key, dims, n_stages,
-                                                       args.tp)
+                                                       args.tp,
+                                                       overlap=args.overlap)
         in_is_image = False
     else:
         from simple_distributed_machine_learning_tpu.models.mlp import (
@@ -300,7 +316,7 @@ def _dispatch(args) -> None:
     pipe = Pipeline(stages, mesh, wire_dim, out_dim,
                     n_microbatches=args.microbatches,
                     compute_dtype=_compute_dtype(args), remat=args.remat,
-                    schedule=args.schedule)
+                    schedule=args.schedule, overlap=args.overlap)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
@@ -401,7 +417,8 @@ def _run_gpt(args, n_stages: int, key) -> None:
                     n_experts=args.experts,
                     moe_top_k=min(2, max(1, args.experts)),
                     attn_impl=args.attn, n_seq=args.sp,
-                    n_expert_parallel=args.ep, **fb)
+                    n_expert_parallel=args.ep,
+                    n_tensor_parallel=args.tp, overlap=args.overlap, **fb)
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
     def as_ds(x, y):
         return Dataset(x.astype(np.float32), y)
@@ -422,12 +439,12 @@ def _run_gpt(args, n_stages: int, key) -> None:
         train_ds = as_ds(all_data.x[:6000], all_data.y[:6000])
         test_ds = as_ds(all_data.x[6000:], all_data.y[6000:])
 
-    mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_seq=args.sp,
-                     n_expert=args.ep)
+    mesh = make_mesh(n_stages=n_stages, n_data=args.dp, n_model=args.tp,
+                     n_seq=args.sp, n_expert=args.ep)
     pipe = Pipeline(stages, mesh, wire_dim, out_shape,
                     n_microbatches=args.microbatches,
                     compute_dtype=_compute_dtype(args), remat=args.remat,
-                    schedule=args.schedule)
+                    schedule=args.schedule, overlap=args.overlap)
     config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
                          learning_rate=args.lr, momentum=args.momentum,
                          seed=args.seed, checkpoint_dir=args.checkpoint_dir,
@@ -461,9 +478,9 @@ def _print_sample(args, trainer, cfg, test_ds) -> None:
     n_new = min(args.generate, cfg.seq_len - 1)
     t0 = max(1, min(cfg.seq_len - n_new, 16))
     pipe = trainer.pipe
-    if cfg.n_experts > 0 or cfg.n_seq > 1:
-        trainer._print("| --generate: skipped (MoE/seq-parallel builds "
-                       "decode via models.make_decoder)")
+    if cfg.n_experts > 0 or cfg.n_seq > 1 or cfg.n_tensor_parallel > 1:
+        trainer._print("| --generate: skipped (MoE/seq-/tensor-parallel "
+                       "builds decode via models.make_decoder)")
         return
     if pipe.n_stages >= 2:
         # pipeline-parallel decode: stage-sharded params stay put, so this

@@ -88,6 +88,20 @@ class GPTConfig:
     # Routing groups (one sequence each) are identical to the dense path, so
     # EP is numerically exact vs n_expert_parallel=1.
     n_expert_parallel: int = 1
+    # tensor (Megatron) parallelism: n_tensor_parallel > 1 shards every
+    # block's QKV/O projections (by head) and MLP hidden width over the
+    # mesh's "model" axis. Init slices the same dense init, so a TP run
+    # matches the dense run to float tolerance. Dense attention + dense MLP
+    # blocks only (no MoE/seq-parallel/flash composition).
+    n_tensor_parallel: int = 1
+    # collective schedule for the TP all-reduces (and the EP dispatch):
+    #   "none" — monolithic lax.psum / all_to_all: the chip blocks for the
+    #            whole collective after the widest matmuls
+    #   "ring" — ppermute-chunked latency-hiding collective matmuls
+    #            (parallel/overlap.py): allgather_matmul + reduce-scatter
+    #            ring through each block's MLP, chunked-psum ring on the
+    #            attention output projection; same losses to float tolerance
+    overlap: str = "none"
 
     def __post_init__(self):
         if self.attn_impl not in ("dense", "flash", "ring", "ulysses"):
@@ -122,6 +136,36 @@ class GPTConfig:
             raise ValueError(
                 f"n_expert_parallel={self.n_expert_parallel} needs "
                 f"n_experts ({self.n_experts}) > 0 and divisible by it")
+        if self.overlap not in ("none", "ring"):
+            raise ValueError(
+                f"overlap must be 'none' or 'ring', got {self.overlap!r}")
+        ntp = self.n_tensor_parallel
+        if ntp < 1:
+            raise ValueError(f"n_tensor_parallel must be >= 1, got {ntp}")
+        if ntp > 1:
+            if self.n_heads % ntp:
+                raise ValueError(
+                    f"n_tensor_parallel={ntp} needs n_heads "
+                    f"({self.n_heads}) divisible by it")
+            if (self.mlp_ratio * self.d_model) % ntp:
+                raise ValueError(
+                    f"n_tensor_parallel={ntp} needs the MLP hidden width "
+                    f"({self.mlp_ratio * self.d_model}) divisible by it")
+            if self.attn_impl != "dense":
+                raise ValueError(
+                    f"tensor parallelism shards attention by head and "
+                    f"computes dense math on the local heads; "
+                    f"attn_impl={self.attn_impl!r} is not composable with it")
+            if self.n_experts > 0 or self.n_expert_parallel > 1:
+                raise ValueError(
+                    "a stage cannot be both tensor- and expert-sharded "
+                    "(Stage.shards vs expert_shards): use n_tensor_parallel "
+                    "with dense-MLP blocks only")
+            if self.n_seq > 1:
+                raise ValueError(
+                    "n_tensor_parallel > 1 with n_seq > 1 is not supported "
+                    "(the wire's token sharding and the TP row scatter "
+                    "would both claim the token axis)")
 
 
 def _block_init(key: jax.Array, cfg: GPTConfig) -> dict:
@@ -202,7 +246,8 @@ def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
             hn_loc = jax.lax.dynamic_slice_in_dim(hn, i * nb, nb, 0)
             m_loc, aux_v = jax.vmap(
                 lambda t: moe_apply_ep(params["moe"], t, k=cfg.moe_top_k,
-                                       capacity=cap))(hn_loc)
+                                       capacity=cap,
+                                       overlap=cfg.overlap))(hn_loc)
             aux = jnp.mean(aux_v)   # already pmean'd over the expert axis
             m = jax.lax.all_gather(m_loc, EXPERT_AXIS, axis=0, tiled=True)
         else:
@@ -214,6 +259,144 @@ def _block_apply(params: dict, h: jax.Array, cfg: GPTConfig, key: jax.Array,
         m = linear(params["mlp_out"], jax.nn.gelu(linear(params["mlp_in"], hn)))
     m = dropout(k2, m, cfg.dropout_rate, deterministic)
     return h + m, aux
+
+
+def _slice_tp_block(bp: dict, m: int, mp: int) -> dict:
+    """Model-shard ``m``'s slice of one dense block's params (Megatron):
+    QKV columns / O rows by head, MLP hidden width column→row; norms and the
+    MLP output bias replicated. Slicing the SAME dense init keeps a TP run
+    numerically identical to the dense run (tests/test_overlap.py)."""
+    d = bp["attn"]["wq"].shape[0]
+    dc = d // mp                      # head-aligned qkv column chunk
+    hc = bp["mlp_in"]["w"].shape[1] // mp
+    return {
+        "ln1": bp["ln1"],
+        "attn": {"wq": bp["attn"]["wq"][:, m * dc:(m + 1) * dc],
+                 "wk": bp["attn"]["wk"][:, m * dc:(m + 1) * dc],
+                 "wv": bp["attn"]["wv"][:, m * dc:(m + 1) * dc],
+                 "wo": bp["attn"]["wo"][m * dc:(m + 1) * dc, :]},
+        "ln2": bp["ln2"],
+        "mlp_in": {"w": bp["mlp_in"]["w"][:, m * hc:(m + 1) * hc],
+                   "b": bp["mlp_in"]["b"][m * hc:(m + 1) * hc]},
+        "mlp_out": {"w": bp["mlp_out"]["w"][m * hc:(m + 1) * hc, :],
+                    "b": bp["mlp_out"]["b"]},
+    }
+
+
+def _slice_tp_stage(params: dict, m: int, mp: int) -> dict:
+    """Model-shard ``m``'s stage tree: blocks sliced, embed/head replicated
+    (stored per-shard like the MLP TP pair's output bias — grad_sync'd)."""
+    out = {"blocks": [_slice_tp_block(bp, m, mp) for bp in params["blocks"]]}
+    for k in ("embed", "head"):
+        if k in params:
+            out[k] = params[k]
+    return out
+
+
+def _is_tp_sharded_leaf(path) -> bool:
+    """True for leaves genuinely split across the model axis — their grads
+    arrive through the TP collectives' transposes; everything else (norms,
+    the MLP output bias, embed, head) is replicated-in-sharded-storage and
+    needs grad_sync over the model axis."""
+    keys = [getattr(p, "key", None) for p in path]
+    if "attn" in keys or "mlp_in" in keys:
+        return True
+    return "mlp_out" in keys and keys[-1] == "w"
+
+
+def _grad_sync_non_tp(params: dict, overlap: str) -> dict:
+    import jax.tree_util as jtu
+
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+        grad_sync,
+    )
+    return jtu.tree_map_with_path(
+        lambda path, leaf: (leaf if _is_tp_sharded_leaf(path)
+                            else grad_sync(leaf, MODEL_AXIS, overlap)),
+        params)
+
+
+def _block_apply_tp(params: dict, h: jax.Array, cfg: GPTConfig,
+                    key: jax.Array, deterministic: bool) -> jax.Array:
+    """One transformer block, tensor-parallel over the model axis — call
+    inside ``shard_map``. ``params`` is THIS shard's slice
+    (:func:`_slice_tp_block`); ``h`` is replicated and the return is too.
+
+    Attention: QKV project onto the local ``H/mp`` heads (column shards are
+    head-aligned), dense causal math runs on them, and the output projection
+    is row-parallel — closed by ``lax.psum`` (``overlap='none'``) or the
+    chunked-psum ring of :func:`~..parallel.overlap.ring_psum`.
+
+    MLP with ``overlap='ring'`` runs the full scattered collective-matmul
+    pair: each device takes its ``1/mp`` row slice of the (replicated)
+    tokens, :func:`~..parallel.overlap.allgather_matmul` re-gathers them
+    chunk-by-chunk under the column matmul,
+    :func:`~..parallel.overlap.matmul_reducescatter` ring-accumulates the
+    row matmul's partial products, and a ring all-gather restores
+    replication — every hop hidden under a chunk's compute, forward and
+    backward (the custom_vjp mirrors). Falls back to the chunked-psum form
+    when the token count does not divide by ``mp``. ``overlap='none'`` is
+    the monolithic Megatron schedule (one blocking psum).
+    """
+    from jax import lax
+
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        pvary_to,
+        vma_of,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.overlap import (
+        allgather_matmul,
+        matmul_reducescatter,
+        ring_all_gather,
+        ring_psum,
+    )
+
+    mp = cfg.n_tensor_parallel
+    ring = cfg.overlap == "ring"
+    axis = MODEL_AXIS
+
+    def reduce_full(z):
+        # replicated all-reduce of a row-parallel product, typed to match
+        # the (varying) residual stream for the vma checker
+        red = ring_psum(z, axis) if ring else lax.psum(z, axis)
+        return pvary_to(red, tuple(vma_of(h)))
+
+    k1, k2 = jax.random.split(key)
+    hn = layer_norm(params["ln1"], h)
+    h_loc = cfg.n_heads // mp
+    q = _split_heads(hn @ params["attn"]["wq"], h_loc)
+    k_ = _split_heads(hn @ params["attn"]["wk"], h_loc)
+    v = _split_heads(hn @ params["attn"]["wv"], h_loc)
+    a = _merge_heads(causal_attention_core(q, k_, v))      # [B, T, d/mp]
+    a = reduce_full(a @ params["attn"]["wo"])
+    h = h + dropout(k1, a, cfg.dropout_rate, deterministic)
+
+    hn2 = layer_norm(params["ln2"], h)
+    b, t, d = hn2.shape
+    rows = hn2.reshape(b * t, d)
+    if ring and (b * t) % mp == 0:
+        n_loc = (b * t) // mp
+        i = lax.axis_index(axis)
+        x_shard = lax.dynamic_slice_in_dim(rows, i * n_loc, n_loc, 0)
+        mid = jax.nn.gelu(
+            allgather_matmul(x_shard, params["mlp_in"]["w"], axis)
+            + params["mlp_in"]["b"])
+        y_shard = matmul_reducescatter(mid, params["mlp_out"]["w"], axis)
+        m = (ring_all_gather(y_shard, axis).reshape(b, t, d)
+             + params["mlp_out"]["b"])
+        m = pvary_to(m, tuple(vma_of(h)))
+    else:
+        mid = jax.nn.gelu(rows @ params["mlp_in"]["w"]
+                          + params["mlp_in"]["b"])
+        m = reduce_full((mid @ params["mlp_out"]["w"]).reshape(b, t, d))
+        m = m + params["mlp_out"]["b"]
+    return h + dropout(k2, m, cfg.dropout_rate, deterministic)
 
 
 def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
@@ -231,6 +414,16 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
     position, and attention runs as the configured seq collective. Build the
     Pipeline on a ``make_mesh(..., n_seq=cfg.n_seq)`` mesh; the returned
     out_dim stays GLOBAL — the engine reassembles the token axis.
+
+    With ``cfg.n_tensor_parallel > 1`` the stages are tensor-parallel
+    (Megatron): every block's QKV/O projections shard by head and the MLP
+    hidden width column→row over the mesh's ``model`` axis
+    (``Stage.shards``), with ``cfg.overlap`` choosing the collective
+    schedule (monolithic psum vs the latency-hiding ppermute rings of
+    ``parallel/overlap.py``). Build on a ``make_mesh(...,
+    n_model=cfg.n_tensor_parallel)`` mesh. Single-device decode helpers
+    (``generate``/``make_decoder``/``fused_reference``) need an unsharded
+    build of the same weights — the same restriction as ``n_seq > 1``.
     """
     if cfg.n_layers < n_stages and not (n_stages == 1 and cfg.n_layers == 0):
         raise ValueError(
@@ -268,6 +461,12 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
                 # needs grad_sync over the expert axis to receive its full
                 # gradient on every replica
                 params = _grad_sync_non_expert(params)
+            if cfg.n_tensor_parallel > 1:
+                # likewise for a tensor-sharded row: QKV/O and MLP weights
+                # are genuinely per-device (their grads arrive through the
+                # TP collectives' transposes); norms, the MLP output bias,
+                # embed and head are replicated-in-sharded-storage
+                params = _grad_sync_non_tp(params, cfg.overlap)
             if _first:
                 ids = x.astype(jnp.int32)                     # tokens on the wire
                 pos = params["embed"]["pos"]
@@ -283,9 +482,15 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
                 h = x                                         # [B, T_loc, d]
             aux = jnp.float32(0.0)
             for i in range(_n):
-                h, a = _block_apply(params["blocks"][i], h, cfg,
-                                    jax.random.fold_in(key, i), deterministic)
-                aux = aux + a
+                if cfg.n_tensor_parallel > 1:
+                    h = _block_apply_tp(params["blocks"][i], h, cfg,
+                                        jax.random.fold_in(key, i),
+                                        deterministic)
+                else:
+                    h, a = _block_apply(params["blocks"][i], h, cfg,
+                                        jax.random.fold_in(key, i),
+                                        deterministic)
+                    aux = aux + a
             if _last:
                 h = layer_norm(params["head"]["ln_f"], h)
                 h = log_softmax(linear(params["head"]["out"], h))
@@ -299,6 +504,13 @@ def make_gpt_stages(key: jax.Array, cfg: GPTConfig = GPTConfig(),
                            for e in range(cfg.n_expert_parallel))
             stages.append(Stage(apply=apply, params=shards[0],
                                 in_shape=in_shape, expert_shards=shards))
+        elif cfg.n_tensor_parallel > 1:
+            # slice the SAME dense init per model shard (Megatron layout):
+            # the TP pipeline matches the dense build to float tolerance
+            shards = tuple(_slice_tp_stage(params, m, cfg.n_tensor_parallel)
+                           for m in range(cfg.n_tensor_parallel))
+            stages.append(Stage(apply=apply, params=shards[0],
+                                in_shape=in_shape, shards=shards))
         else:
             stages.append(Stage(apply=apply, params=params, in_shape=in_shape))
 

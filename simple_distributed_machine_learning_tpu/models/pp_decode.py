@@ -54,8 +54,9 @@ from simple_distributed_machine_learning_tpu.parallel.mesh import (
     MODEL_AXIS,
     STAGE_AXIS,
 )
-from simple_distributed_machine_learning_tpu.parallel.pipeline import (
-    _pvary_to,
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    pvary_to as _pvary_to,
+    shard_map as _shard_map,
 )
 from simple_distributed_machine_learning_tpu.parallel.staging import (
     unpack_stage_params,
@@ -254,7 +255,7 @@ def make_pp_decoder(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
         # types the output invariant for the out_spec
         return lax.psum(lax.psum(out, MODEL_AXIS), EXPERT_AXIS)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         per_device,
         mesh=pipe.mesh,
         in_specs=(pipe.param_spec(), P(DATA_AXIS), P()),

@@ -33,3 +33,10 @@ from simple_distributed_machine_learning_tpu.parallel.expert import (  # noqa: F
     moe_apply_ep,
     moe_init,
 )
+from simple_distributed_machine_learning_tpu.parallel.overlap import (  # noqa: F401
+    allgather_matmul,
+    matmul_reducescatter,
+    ring_all_gather,
+    ring_psum,
+    ring_reduce_scatter,
+)

@@ -34,6 +34,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    axis_size as _axis_size,
+)
+
 from simple_distributed_machine_learning_tpu.ops.layers import linear_init
 
 EXPERT_AXIS = "expert"
@@ -146,18 +150,35 @@ def moe_apply(params: dict, x: jax.Array, k: int = 2,
 
 
 def moe_apply_ep(params: dict, x: jax.Array, k: int = 2,
-                 capacity: int | None = None, axis: str = EXPERT_AXIS
-                 ) -> tuple[jax.Array, jax.Array]:
+                 capacity: int | None = None, axis: str = EXPERT_AXIS,
+                 overlap: str = "none") -> tuple[jax.Array, jax.Array]:
     """Expert-parallel MoE FFN — call inside ``shard_map`` over ``axis``.
 
     ``params['experts']`` is THIS device's ``[E/D, ...]`` expert shard; the
     router is replicated. ``x``: this device's ``[T_local, d]`` token shard.
     ``capacity`` is per (expert, source device) — each expert's total buffer is
-    ``D * capacity``. Two ``all_to_all`` collectives over ICI; everything else
-    is local MXU work. Returns this shard's ``(y [T_local, d], aux_loss)``
+    ``D * capacity``. Returns this shard's ``(y [T_local, d], aux_loss)``
     (aux is psum-averaged over the axis so every shard sees the global value).
+
+    ``overlap='none'``: the canonical 2x ``all_to_all`` schedule — dispatch
+    everything, run one batched FFN, ship everything back; the chip blocks
+    for each full exchange. ``overlap='ring'``: the dispatch/combine exchange
+    decomposes into ``D-1`` ppermute offset hops (``parallel/overlap.py``
+    style): each remote shard's capacity buffer FFNs as it arrives while the
+    next offset's buffer is in flight, and results stream back on the
+    mirrored permute — same math per capacity slot, so parity with the
+    all_to_all path is to float tolerance (the FFN matmul batches differ:
+    ``[E/D, C, d]`` per chunk vs ``[E/D, D*C, d]`` in one piece).
     """
-    D = lax.axis_size(axis)
+    from simple_distributed_machine_learning_tpu.parallel.overlap import (
+        check_overlap,
+    )
+    from simple_distributed_machine_learning_tpu.utils.profiler import (
+        annotate_scope,
+    )
+
+    check_overlap(overlap)
+    D = _axis_size(axis)
     T, _ = x.shape
     E = n_experts_of(params)                             # global expert count
     capacity = default_capacity(T, E, k) if capacity is None else capacity
@@ -165,13 +186,40 @@ def moe_apply_ep(params: dict, x: jax.Array, k: int = 2,
     aux = lax.pmean(aux, axis)
 
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)   # [E, C, d] local contrib
-    # ship each expert's buffer to its owner: split the E axis D-ways, concat
-    # the shards' contributions along capacity → [E/D, D*C, d] on the owner
-    expert_in = lax.all_to_all(expert_in, axis, split_axis=0, concat_axis=1,
-                               tiled=True)
-    expert_out = _expert_ffn(params["experts"], expert_in)
-    # inverse exchange: send each source shard its slice back → [E, C, d]
-    expert_out = lax.all_to_all(expert_out, axis, split_axis=1, concat_axis=0,
-                                tiled=True)
+    if overlap == "ring" and D > 1:
+        e_loc = E // D
+        i = lax.axis_index(axis)
+        expert_out = jnp.zeros_like(expert_in)
+        # own chunk first — no hop to hide it under
+        with annotate_scope("moe_ep_ring/chunk0"):
+            own = lax.dynamic_slice_in_dim(expert_in, i * e_loc, e_loc, 0)
+            expert_out = lax.dynamic_update_slice_in_dim(
+                expert_out, _expert_ffn(params["experts"], own), i * e_loc, 0)
+        for s in range(1, D):
+            # offset-s exchange: send the chunk destined for owner i+s, FFN
+            # the chunk arriving from source i-s, return it on the mirrored
+            # permute — XLA overlaps offset s+1's hop with offset s's FFN
+            fwd = [(j, (j + s) % D) for j in range(D)]
+            rev = [(j, (j - s) % D) for j in range(D)]
+            dst = (i + s) % D
+            with annotate_scope(f"moe_ep_ring/hop{s}"):
+                send = lax.dynamic_slice_in_dim(expert_in, dst * e_loc,
+                                                e_loc, 0)
+                recv = lax.ppermute(send, axis, fwd)
+            with annotate_scope(f"moe_ep_ring/chunk{s}"):
+                y_chunk = _expert_ffn(params["experts"], recv)
+            with annotate_scope(f"moe_ep_ring/return{s}"):
+                back = lax.ppermute(y_chunk, axis, rev)
+                expert_out = lax.dynamic_update_slice_in_dim(
+                    expert_out, back, dst * e_loc, 0)
+    else:
+        # ship each expert's buffer to its owner: split the E axis D-ways,
+        # concat the shards' contributions along capacity → [E/D, D*C, d]
+        expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        expert_out = _expert_ffn(params["experts"], expert_in)
+        # inverse exchange: send each source shard its slice back → [E, C, d]
+        expert_out = lax.all_to_all(expert_out, axis, split_axis=1,
+                                    concat_axis=0, tiled=True)
     y = jnp.einsum("tec,ecd->td", combine, expert_out)
     return y, aux

@@ -118,8 +118,10 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     compute_dtype = pipe.compute_dtype
     n_data = pipe.n_data
     from simple_distributed_machine_learning_tpu.ops.losses import nll_loss
-    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
-        _pvary_to,
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        pvary_to as _pvary_to,
+        shard_map as _shard_map,
+        vma_of as _vma_of,
     )
 
     # sequence parallelism: the token axis of the wire, targets and logits
@@ -254,7 +256,7 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
             valued replication proof that drops it (the GPipe engine's
             logits/num trick); then pvary any missing axes."""
             for ax in shard_axes:
-                if ax in getattr(jax.typeof(v), "vma", frozenset()):
+                if ax in _vma_of(v):
                     v = lax.pmean(v, ax)
             return _pvary_to(v, wire_axes)
 
@@ -281,7 +283,7 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
                 # the last stage's never-on-the-wire output; 1 for the
                 # scalar objective contribution)
                 def like(ct, primal):
-                    vma = tuple(getattr(jax.typeof(primal), "vma", ()))
+                    vma = tuple(_vma_of(primal))
                     return _pvary_to(ct, vma)
                 cot_out = (like(jnp.zeros(cot_wire.shape, cot_wire.dtype),
                                 primals[0]) if is_last
@@ -404,7 +406,7 @@ def build_1f1b_fn(pipe, deterministic: bool) -> Callable:
     seq_or_none = SEQ_AXIS if seq_on else None
     tok_axes = len(out_shape) - 1
     tgt_tok = ((seq_or_none,) + (None,) * (tok_axes - 1)) if tok_axes else ()
-    return jax.shard_map(
+    return _shard_map(
         per_device,
         mesh=pipe.mesh,
         in_specs=(pipe.param_spec(), P(None, DATA_AXIS, seq_or_none),

@@ -46,12 +46,10 @@ from simple_distributed_machine_learning_tpu.parallel.mesh import (
 )
 
 
-def _pvary_to(x: jax.Array, axes: tuple[str, ...]) -> jax.Array:
-    """pcast ``x`` to varying over exactly the axes of ``axes`` it does not
-    already vary over (pcast rejects mixed already/not-yet-varying sets)."""
-    have = getattr(jax.typeof(x), "vma", frozenset())
-    missing = tuple(a for a in axes if a not in have)
-    return lax.pcast(x, missing, to="varying") if missing else x
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    pvary_to as _pvary_to,
+    shard_map as _shard_map,
+)
 from simple_distributed_machine_learning_tpu.parallel.staging import (
     StageMeta,
     pack_stage_params,
@@ -115,9 +113,21 @@ class Pipeline:
     def __init__(self, stages: Sequence[Stage], mesh: jax.sharding.Mesh,
                  wire_dim: int, out_dim: int | tuple[int, ...],
                  n_microbatches: int = 1, compute_dtype=None,
-                 remat: bool = False, schedule: str = "gpipe"):
+                 remat: bool = False, schedule: str = "gpipe",
+                 overlap: str = "none"):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown schedule {schedule!r}")
+        from simple_distributed_machine_learning_tpu.parallel.overlap import (
+            check_overlap,
+        )
+        # the engine-level knob covers the engine's OWN collectives — the
+        # backward grad_sync all-reduce of stages stored replicated over the
+        # model/expert axes becomes the chunked ppermute ring of
+        # overlap.ring_psum. Stage-internal collectives (TP pairs, TP GPT
+        # blocks, EP dispatch) carry their own overlap choice from the model
+        # build. The 1F1B engine (onefb.py) does its own replication
+        # accounting without grad_sync and ignores this knob.
+        self.overlap = check_overlap(overlap)
         self.schedule = schedule
         self.stages = list(stages)
         self.mesh = mesh
@@ -324,7 +334,7 @@ class Pipeline:
             shard_shape.append(tuple(y.shape[1:]))
             return y.reshape(xx.shape[0], -1)
 
-        fn = jax.shard_map(
+        fn = _shard_map(
             run, mesh=self.mesh,
             in_specs=(p_spec, x_spec, P()),
             out_specs=P(None, SEQ_AXIS if self.n_seq > 1 else None),
@@ -433,6 +443,7 @@ class Pipeline:
         # 1/axis_size, gradient
         replicated_over_model = [s.shards is None for s in self.stages]
         replicated_over_expert = [s.expert_shards is None for s in self.stages]
+        overlap = self.overlap
         compute_dtype = self.compute_dtype
         remat = self.remat
         # every mesh axis the loop's values can vary over (data via inputs,
@@ -459,10 +470,12 @@ class Pipeline:
                     params = unpack_stage_params(row, metas[s])
                     if n_model > 1 and replicated_over_model[s]:
                         params = jax.tree.map(
-                            lambda a: grad_sync(a, MODEL_AXIS), params)
+                            lambda a: grad_sync(a, MODEL_AXIS, overlap),
+                            params)
                     if n_expert > 1 and replicated_over_expert[s]:
                         params = jax.tree.map(
-                            lambda a: grad_sync(a, EXPERT_AXIS), params)
+                            lambda a: grad_sync(a, EXPERT_AXIS, overlap),
+                            params)
                     x = wire_decode(wire, in_shapes[s])
                     if compute_dtype is not None:
                         params = jax.tree.map(
@@ -586,11 +599,16 @@ class Pipeline:
             # the init carry is device-uniform but the loop body makes it
             # vary over every mesh axis (params vary over stage/model/expert,
             # data over data, seq-sharded tokens over seq); pcast aligns the
-            # carry types for check_vma
+            # carry types for check_vma. The scalar accumulators ride as
+            # shape-(1,) arrays: scan-resident rank-0 carries trip the
+            # scalar-residual promotion of older jax's shard_map partial
+            # eval, and the singleton axis is free either way
             init0 = (jnp.zeros((mb, wire_dim), x_mb.dtype),
-                     jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+                     jnp.zeros((1,), jnp.float32),
+                     jnp.zeros((1,), jnp.float32),
+                     jnp.zeros((1,), jnp.float32))
             if metrics:
-                init0 += (jnp.int32(0),)
+                init0 += (jnp.zeros((1,), jnp.int32),)
             elif not loss_only:
                 init0 += (jnp.zeros((M, mb) + out_shape, jnp.float32),)
             init = jax.tree.map(lambda a: _pvary_to(a, vary_axes), init0)
@@ -599,8 +617,10 @@ class Pipeline:
                 _, num, den, aux = carry_out
             elif metrics:
                 _, num, den, aux, correct = carry_out
+                correct = correct[0]
             else:
                 _, num, den, aux, logits_acc = carry_out
+            num, den, aux = num[0], den[0], aux[0]
 
             # weighted global mean: sum(w * nll) / sum(w), reduced over the
             # stage axis (only the last stage contributed), the data axis,
@@ -671,7 +691,7 @@ class Pipeline:
         seq_or_none = SEQ_AXIS if seq_on else None
         tgt_tok = ((seq_or_none,) + (None,) * (tok_axes - 1)
                    if tok_axes else ())
-        fn = jax.shard_map(
+        fn = _shard_map(
             per_device,
             mesh=self.mesh,
             in_specs=(self.param_spec(),

@@ -25,6 +25,10 @@ from __future__ import annotations
 import jax
 from jax import lax
 
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    axis_size as _axis_size,
+)
+
 from simple_distributed_machine_learning_tpu.ops.attention import (
     SEQ_AXIS,
     causal_attention_core,
@@ -47,7 +51,7 @@ def ulysses_attention(params: dict, x: jax.Array, n_heads: int,
     crossing devices — causality is exact); the reverse ``all_to_all``
     restores sequence sharding for the output projection.
     """
-    s = lax.axis_size(axis)
+    s = _axis_size(axis)
     if n_heads % s:
         raise ValueError(f"{n_heads} heads not divisible by axis size {s}")
     b, t_loc, d = x.shape

@@ -56,8 +56,8 @@ def tp_pair_init(key: jax.Array, d_in: int, d_hidden: int, d_out: int,
     return shards
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def grad_sync(x: jax.Array, axis: str) -> jax.Array:
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def grad_sync(x: jax.Array, axis: str, overlap: str = "none") -> jax.Array:
     """Identity forward; psum over ``axis`` backward.
 
     For params that are REPLICATED over a mesh axis inside ``shard_map`` but
@@ -71,15 +71,28 @@ def grad_sync(x: jax.Array, axis: str) -> jax.Array:
     train too slowly. Wrapping such params in ``grad_sync`` restores the full
     gradient on every replica (and keeps replicas bit-identical, since each
     gets the same psum).
+
+    ``overlap='ring'`` runs the backward all-reduce as the chunked ppermute
+    ring of :func:`~.overlap.ring_psum` instead of one blocking ``lax.psum``,
+    so the gradient sync of wide replicated leaves hides its ICI transfer
+    under neighbouring backward compute (ring summation order: replicas stay
+    bit-identical to each other, tolerance-equal to the monolithic psum).
     """
     return x
 
 
-def _grad_sync_fwd(x, axis):
+def _grad_sync_fwd(x, axis, overlap):
     return x, None
 
 
-def _grad_sync_bwd(axis, _, ct):
+def _grad_sync_bwd(axis, overlap, _, ct):
+    if overlap == "ring":
+        from simple_distributed_machine_learning_tpu.parallel.overlap import (
+            _bwd_perm,
+            _ring_psum_impl,
+        )
+        return (_ring_psum_impl(ct, axis, perm_fn=_bwd_perm,
+                                tag="grad_sync_ring"),)
     return (lax.psum(ct, axis),)
 
 
@@ -87,20 +100,38 @@ grad_sync.defvjp(_grad_sync_fwd, _grad_sync_bwd)
 
 
 def tp_pair_apply(params: dict, x: jax.Array, activation=jax.nn.relu,
-                  axis: str = MODEL_AXIS) -> jax.Array:
+                  axis: str = MODEL_AXIS, overlap: str = "none") -> jax.Array:
     """Column→activation→row parallel pair. Call inside shard_map; ``params``
-    is THIS device's shard. One psum over ``axis`` per call; the output bias
-    is replicated and added after the reduce (see :func:`tp_pair_init`), with
-    :func:`grad_sync` restoring its full (unsplit) gradient.
+    is THIS device's shard. One all-reduce over ``axis`` per call; the output
+    bias is replicated and added after the reduce (see :func:`tp_pair_init`),
+    with :func:`grad_sync` restoring its full (unsplit) gradient.
+
+    ``overlap='none'``: the Megatron monolithic ``lax.psum`` — the chip
+    blocks for the full collective after the row matmul. ``overlap='ring'``:
+    the chunked-psum collective matmul of :func:`~.overlap.ring_psum` — the
+    partial products ring-shift chunk by chunk so each hop hides under
+    another chunk's accumulate (forward AND backward; tolerance-equal, see
+    overlap.py's numerics note).
 
     The ``pmean`` around the bias is the vma-checker's replication proof:
     the replicas are bit-identical (grad_sync keeps them in sync), so it is
     the identity value-wise, and its transpose (ct/n per replica) composes
     with grad_sync's psum to hand every replica the full cotangent — the
-    same accounting the implicit replicated out_spec used to do."""
+    same accounting the implicit replicated out_spec used to do. On the ring
+    path the reduced value stays varying-typed (ppermutes carry no
+    replication proof), so the bias term is pcast up to match."""
     h = activation(x @ params["w1"]["w"] + params["w1"]["b"])
-    return lax.psum(h @ params["w2"]["w"], axis) + lax.pmean(
-        grad_sync(params["w2"]["b"], axis), axis)
+    z = h @ params["w2"]["w"]
+    bias = lax.pmean(grad_sync(params["w2"]["b"], axis, overlap), axis)
+    if overlap == "ring":
+        from simple_distributed_machine_learning_tpu.parallel.compat import (
+            pvary_to,
+        )
+        from simple_distributed_machine_learning_tpu.parallel.overlap import (
+            ring_psum,
+        )
+        return ring_psum(z, axis) + pvary_to(bias, (axis,))
+    return lax.psum(z, axis) + bias
 
 
 def stack_tp_shards(shards: list[dict]):
@@ -109,7 +140,8 @@ def stack_tp_shards(shards: list[dict]):
     return jax.tree.map(lambda *ls: jnp.stack(ls), *shards)
 
 
-def make_mlp_tp_stages(key: jax.Array, dims, n_stages: int, n_model: int):
+def make_mlp_tp_stages(key: jax.Array, dims, n_stages: int, n_model: int,
+                       overlap: str = "none"):
     """Tensor-parallel MLP pipeline stages: dp x pp x tp in one step.
 
     Like :func:`~..models.mlp.make_mlp_stages` but each stage is a
@@ -120,12 +152,20 @@ def make_mlp_tp_stages(key: jax.Array, dims, n_stages: int, n_model: int):
     layers, so the TP pipeline matches a dense single-device run to float
     tolerance (tests/test_tp_pipeline.py).
 
+    ``overlap``: the collective schedule of every pair's all-reduce —
+    ``'none'`` (monolithic psum) or ``'ring'`` (latency-hiding chunked ring,
+    ``overlap.ring_psum``; same losses to float tolerance).
+
     Returns ``(stages, wire_dim, out_dim)`` for :class:`~.pipeline.Pipeline`
     on a ``make_mesh(n_stages=..., n_model=...)`` mesh.
     """
     from simple_distributed_machine_learning_tpu.ops.losses import log_softmax
+    from simple_distributed_machine_learning_tpu.parallel.overlap import (
+        check_overlap,
+    )
     from simple_distributed_machine_learning_tpu.parallel.pipeline import Stage
 
+    check_overlap(overlap)
     dims = [int(d) for d in dims]
     if len(dims) != 2 * n_stages + 1:
         raise ValueError(
@@ -140,7 +180,8 @@ def make_mlp_tp_stages(key: jax.Array, dims, n_stages: int, n_model: int):
         is_last = s == n_stages - 1
 
         def apply(params, x, key, deterministic, _last=is_last):
-            y = tp_pair_apply(params, x, activation=jax.nn.relu)
+            y = tp_pair_apply(params, x, activation=jax.nn.relu,
+                              overlap=overlap)
             return log_softmax(y) if _last else jax.nn.relu(y)
 
         stages.append(Stage(apply=apply, params=shards[0],

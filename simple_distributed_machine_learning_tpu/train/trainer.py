@@ -74,7 +74,8 @@ class TrainConfig:
     # no-op path and the plain numpy feed is used.
     shard_inputs: bool = True
     # machine-readable training log: one JSON line per epoch (epoch, step,
-    # train_loss, samples_per_sec, eval_loss, accuracy) appended to this
+    # train_loss, samples_per_sec, eval_loss, accuracy — plus the raw
+    # correct/n_eval counts the accuracy is computed from) appended to this
     # path by process 0. The console surface stays byte-identical to the
     # reference; this is the structured counterpart (SURVEY §5.5).
     metrics_json: str | None = None
@@ -292,14 +293,18 @@ class Trainer:
         for epoch in range(self.start_epoch, self.config.epochs + 1):
             train_loss = self.train_epoch(epoch)
             eval_loss, correct = self.evaluate()
+            n_eval = int(self.test_ds.y.size)
             self._log_metrics({
                 "epoch": epoch,
                 "step": self._step_count,
                 "train_loss": round(train_loss, 6),
                 "samples_per_sec": round(self._last_samples_per_sec, 1),
                 "eval_loss": round(eval_loss, 6),
+                # accuracy is the documented key (--metrics-json help); the
+                # raw counts stay so consumers can re-aggregate across epochs
+                "accuracy": round(correct / n_eval, 6) if n_eval else None,
                 "correct": correct,
-                "n_eval": int(self.test_ds.y.size),
+                "n_eval": n_eval,
             })
             self._save(epoch)
         if self._pending_save is not None:

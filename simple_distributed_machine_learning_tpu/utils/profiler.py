@@ -28,5 +28,23 @@ def trace(logdir: str = "/tmp/sdml_trace", enabled: bool = True):
 
 
 def annotate(name: str):
-    """Named region that shows up on the trace timeline."""
+    """Named region that shows up on the trace timeline.
+
+    Host-side: annotates the wall-clock interval of the Python block (dispatch,
+    blocking reads). For regions INSIDE a jitted program use
+    :func:`annotate_scope` — a TraceAnnotation entered at trace time would
+    label the tracing, not the execution.
+    """
     return jax.profiler.TraceAnnotation(name)
+
+
+def annotate_scope(name: str):
+    """Named region for ops inside a compiled program.
+
+    ``jax.named_scope`` prefixes the HLO metadata of every op traced under it,
+    which XProf surfaces as a grouped region on the device timeline — the
+    right tool for showing that e.g. each chunk of a ring collective matmul
+    (``parallel/overlap.py``) has its compute overlapped with the next chunk's
+    ICI transfer.
+    """
+    return jax.named_scope(name)

@@ -24,6 +24,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# version-tolerant: jax_num_cpu_devices where it exists, the XLA_FLAGS route
+# (set above) everywhere else
+from simple_distributed_machine_learning_tpu.parallel.compat import (  # noqa: E402
+    set_host_device_count,
+)
+
+set_host_device_count(8)

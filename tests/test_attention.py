@@ -3,6 +3,10 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    shard_map,
+)
 import torch
 
 from simple_distributed_machine_learning_tpu.ops.attention import (
@@ -53,7 +57,7 @@ def test_ring_attention_matches_full():
     x = jax.random.normal(jax.random.key(5), (b, t, d))
 
     mesh = Mesh(np.array(jax.devices()[:n_seq]), ("seq",))
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         lambda p, xx: ring_attention(p, xx, h, "seq"),
         mesh=mesh, in_specs=(P(), P(None, "seq", None)),
         out_specs=P(None, "seq", None)))
@@ -73,7 +77,7 @@ def test_ring_attention_grads_match_full():
     mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
 
     def ring_loss(p, xx):
-        f = jax.shard_map(lambda pp, v: ring_attention(pp, v, 2, "seq"),
+        f = shard_map(lambda pp, v: ring_attention(pp, v, 2, "seq"),
                           mesh=mesh, in_specs=(P(), P(None, "seq", None)),
                           out_specs=P(None, "seq", None))
         return jnp.sum(f(p, xx) ** 2)

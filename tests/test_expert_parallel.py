@@ -5,6 +5,10 @@ grads; capacity semantics drop overflow tokens to zero output."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    shard_map,
+)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from simple_distributed_machine_learning_tpu.parallel.expert import (
@@ -24,7 +28,7 @@ def _ep_fn(mesh, k, capacity):
     def per_device(p, xx):
         return moe_apply_ep(p, xx, k=k, capacity=capacity)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_device, mesh=mesh,
         in_specs=(pspec, P("expert")), out_specs=(P("expert"), P()),
         ))

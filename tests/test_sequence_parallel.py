@@ -3,6 +3,10 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    shard_map,
+)
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -16,7 +20,7 @@ from simple_distributed_machine_learning_tpu.parallel.sequence import (
 
 
 def _sharded(fn, mesh, h):
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda p, xx: fn(p, xx, h, "seq"),
         mesh=mesh, in_specs=(P(), P(None, "seq", None)),
         out_specs=P(None, "seq", None)))
@@ -43,7 +47,7 @@ def test_ulysses_grads_match_full():
     mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
 
     def sp_loss(p, xx):
-        f = jax.shard_map(lambda pp, v: ulysses_attention(pp, v, h, "seq"),
+        f = shard_map(lambda pp, v: ulysses_attention(pp, v, h, "seq"),
                           mesh=mesh, in_specs=(P(), P(None, "seq", None)),
                           out_specs=P(None, "seq", None))
         return jnp.sum(f(p, xx) ** 2)

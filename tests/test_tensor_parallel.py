@@ -3,6 +3,10 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from simple_distributed_machine_learning_tpu.parallel.compat import (
+    shard_map,
+)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from simple_distributed_machine_learning_tpu.ops.layers import linear, linear_init
@@ -33,7 +37,7 @@ def test_tp_pair_matches_dense():
         local = jax.tree.map(lambda l: l[0], p)  # strip sharded leading axis
         return tp_pair_apply(local, xx, axis="model")
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         per_device, mesh=mesh,
         in_specs=(P("model"), P()), out_specs=P()))
     got = f(stacked, x)
@@ -51,7 +55,7 @@ def test_tp_pair_grads_match_dense():
     stacked = stack_tp_shards(shards)
 
     def tp_loss(p, xx):
-        f = jax.shard_map(
+        f = shard_map(
             lambda pp, v: tp_pair_apply(jax.tree.map(lambda l: l[0], pp), v,
                                         axis="model"),
             mesh=mesh, in_specs=(P("model"), P()), out_specs=P(),

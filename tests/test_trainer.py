@@ -116,9 +116,12 @@ def test_metrics_json_records_per_epoch(tmp_path):
     assert [r["epoch"] for r in records] == [1, 2, 3]
     for r in records:
         assert set(r) == {"epoch", "step", "train_loss", "samples_per_sec",
-                          "eval_loss", "correct", "n_eval"}
+                          "eval_loss", "accuracy", "correct", "n_eval"}
         assert r["n_eval"] == 60
         assert 0 <= r["correct"] <= 60
+        # accuracy is the documented headline key; the raw counts it is
+        # computed from stay alongside it
+        assert abs(r["accuracy"] - r["correct"] / r["n_eval"]) < 1e-6
         assert r["samples_per_sec"] >= 0.0
     # steps accumulate across epochs (2 batches/epoch here)
     assert records[-1]["step"] == 6
