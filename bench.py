@@ -234,7 +234,7 @@ def _smoke_check(timeout_s: float = 90.0) -> None:
 
 
 def measure(name: str, spec: dict, windows: int = 5,
-            schedule: str = "gpipe") -> dict:
+            schedule: str = "gpipe", lint: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -276,10 +276,22 @@ def measure(name: str, spec: dict, windows: int = 5,
             cfg = _dc.replace(cfg, attn_impl=spec["attn"],
                               flash_block_q=fb[0], flash_block_k=fb[1])
         tp = spec.get("tp") or 1
+        if tp > 1 or spec.get("overlap"):
+            # full spec validation through the analyzer preflight: device
+            # count, head/hidden divisibility, and the ring-overlap chunk
+            # counts — one clear message instead of a trace-time stack
+            from simple_distributed_machine_learning_tpu.analysis.preflight import (
+                validate_tp_overlap,
+            )
+            errors, warns = validate_tp_overlap(
+                tp, spec.get("overlap") or "none", n_dev, cfg,
+                batch=batch, n_micro=n_micro)
+            for w in warns:
+                sys.stderr.write(f"bench: {name}: {w}\n")
+            if errors:
+                raise SystemExit(f"bench: {name}: invalid --tp/--overlap "
+                                 f"spec:\n  " + "\n  ".join(errors))
         if tp > 1:
-            if tp > n_dev:
-                raise SystemExit(
-                    f"--tp {tp} needs {tp} devices, have {n_dev}")
             # the TP sweep measures the collective schedule, so the whole
             # mesh goes to the model axis (one stage). This also keeps the
             # ring's ppermutes out of divergent lax.switch branches, whose
@@ -312,6 +324,19 @@ def measure(name: str, spec: dict, windows: int = 5,
     opt_state = opt.init(buf)
     step = make_scanned_train_step(pipe, opt, pool_steps=steps)
     key = jax.random.key(0)
+    if lint:
+        # preflight the EXACT scanned step about to be timed (same spec,
+        # schedule, overlap, donation) — abstract trace only, no FLOPs
+        from simple_distributed_machine_learning_tpu.analysis import (
+            abstractify,
+            analyze,
+        )
+        report = analyze(step, abstractify(buf), abstractify(opt_state),
+                         abstractify(xs), abstractify(ts), abstractify(key),
+                         mesh=mesh, name=f"bench:{name}")
+        print(report.format(costs=True))
+        if not report.ok():
+            raise SystemExit(2)
     jax.block_until_ready((xs, ts))
 
     def timed(reps, buf, opt_state):
@@ -567,6 +592,10 @@ def main() -> None:
                          "ring = latency-hiding ppermute-chunked collective "
                          "matmuls (parallel/overlap.py); pair with --tp; "
                          "experiment rows only, like --opt")
+    ap.add_argument("--lint", action="store_true",
+                    help="static-analysis preflight (analysis/): lint the "
+                         "exact scanned step of every row before timing it "
+                         "and abort on ERROR findings")
     args = ap.parse_args()
     # mirror cli.py's validation instead of silently ignoring the flag or
     # dumping a raw ValueError traceback from the int parse
@@ -584,11 +613,17 @@ def main() -> None:
     if args.overlap == "ring" and args.tp is None:
         args.tp = 2          # smallest sharded row: the ring schedule
         #                      measures a collective, which needs a shard
-    if args.overlap == "ring" and args.tp < 2:
-        raise SystemExit("--overlap ring needs --tp >= 2 (there is no "
-                         "collective to schedule on an unsharded row)")
-    if args.tp is not None and args.tp < 1:
-        raise SystemExit(f"--tp must be >= 1, got {args.tp}")
+    if args.tp is not None or args.overlap is not None:
+        # flag-level spec validation through the analyzer preflight (device
+        # count and model-shape divisibility re-checked per row in measure())
+        from simple_distributed_machine_learning_tpu.analysis.preflight import (
+            validate_tp_overlap,
+        )
+        errors, _ = validate_tp_overlap(args.tp if args.tp is not None else 1,
+                                        args.overlap or "none")
+        if errors:
+            raise SystemExit("bench: invalid --tp/--overlap spec:\n  "
+                             + "\n  ".join(errors))
     if (args.tp or args.overlap) and args.config is None and not args.all:
         args.config = "gpt"  # the TP/overlap axes are GPT-row knobs
 
@@ -712,7 +747,7 @@ def main() -> None:
                     spec["tp"] = args.tp
                 if args.overlap is not None:
                     spec["overlap"] = args.overlap
-        res = measure(name, spec, schedule=args.schedule)
+        res = measure(name, spec, schedule=args.schedule, lint=args.lint)
         # vs_baseline only for the headline: the torch-RPC baseline runs the
         # 2-stage MLP workload, not the others
         vs = (round(res["samples_per_sec"] / base, 2)

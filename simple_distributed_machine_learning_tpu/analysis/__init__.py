@@ -1,0 +1,108 @@
+"""Static sharding & collective analysis — the multi-chip preflight gate.
+
+The engine composes five mesh axes (``data``/``stage``/``model``/``seq``/
+``expert``) with ring collectives whose failure modes are silent or
+catastrophic at scale: a branch-divergent ``ppermute`` ring deadlocks the
+collective-permute rendezvous, a missing ``psum`` on a gradient path trains
+a subtly wrong model, a wrong axis name or a sub-fp32 accumulator corrupts
+numerics without crashing. This package traces the EXACT compiled step a
+launch is about to execute to a ``ClosedJaxpr`` — zero FLOPs, no device
+buffers — and runs a pluggable suite of lint passes over it (``rules.py``),
+returning structured findings plus a bytes-over-ICI cost report per
+collective.
+
+Rule families (the catalog table lives in docs/ARCHITECTURE.md):
+
+- ``ppermute-deadlock`` — non-bijective permutations; collectives inside
+  divergent ``cond``/``switch`` branches or varying-trip-count ``while``
+  loops (the PR-2 XLA:CPU caveat, machine-checked);
+- ``unreduced-gradient`` — a shard_map output claiming replication over an
+  axis the dataflow says it still varies over (a dropped grad psum);
+- ``mesh-axis`` — collective axis names absent from the active mesh,
+  permutation endpoints outside the axis, trace-time axis binding errors;
+- ``dtype-drift`` — sub-fp32 cross-device reductions and scan carries that
+  accumulate in sub-fp32;
+- ``donation`` — buffers read after being donated to a jitted call.
+
+Library API::
+
+    from simple_distributed_machine_learning_tpu import analysis
+    report = analysis.analyze(step_fn, buf_sds, state_sds, x_sds, t_sds,
+                              key_sds, mesh=pipe.mesh)
+    print(report.format())
+    if not report.ok():          # any ERROR finding
+        raise SystemExit(1)
+
+CLI (the preflight gate ``cli.py --lint`` / ``bench.py --lint`` wrap)::
+
+    python -m simple_distributed_machine_learning_tpu.analysis --dryrun 8
+    python -m simple_distributed_machine_learning_tpu.analysis --fixtures
+"""
+
+from __future__ import annotations
+
+from simple_distributed_machine_learning_tpu.analysis.report import (
+    CollectiveCost,
+    Finding,
+    Report,
+    Severity,
+)
+from simple_distributed_machine_learning_tpu.analysis.rules import run_rules
+from simple_distributed_machine_learning_tpu.analysis.trace import (
+    abstractify,
+    shape_dtype,
+    trace_to_jaxpr,
+)
+
+__all__ = [
+    "CollectiveCost", "Finding", "Report", "Severity",
+    "abstractify", "analyze", "analyze_jaxpr", "shape_dtype",
+]
+
+
+def analyze_jaxpr(closed_jaxpr, mesh=None, name: str = "") -> Report:
+    """Run the lint suite over an already-traced ``ClosedJaxpr``."""
+    findings, costs = run_rules(closed_jaxpr, active_mesh=mesh)
+    return Report(name=name, findings=findings, costs=costs)
+
+
+def analyze(fn, *abstract_args, mesh=None, name: str = "", **abstract_kwargs
+            ) -> Report:
+    """Trace ``fn`` on abstract args and lint the result.
+
+    ``abstract_args`` are ``jax.ShapeDtypeStruct``s (or concrete arrays —
+    only shapes/dtypes are read; use :func:`abstractify` on real buffers).
+    ``mesh`` is the ACTIVE launch mesh: axis existence and sizes of every
+    collective are checked against it, catching a step traced for one
+    topology and launched on another.
+
+    Trace failures become findings rather than exceptions, so a preflight
+    can always print one report: an unbound axis name (``psum`` over an
+    axis the mesh does not carry) is exactly the ``mesh-axis`` defect this
+    suite exists to catch, and jax surfaces it at bind time.
+    """
+    name = name or getattr(fn, "__name__", "") or "step"
+    try:
+        jaxpr = trace_to_jaxpr(fn, *abstract_args, **abstract_kwargs)
+    except Exception as e:  # noqa: BLE001 - any trace error becomes a finding
+        msg = str(e)
+        rule, hint = "trace.failed", (
+            "the step could not even be traced on these shapes; the error "
+            "above is jax's own diagnosis")
+        low = msg.lower()
+        if "axis name" in msg or "unbound" in low:
+            rule = "mesh-axis.unknown-axis"
+            hint = ("a collective names an axis the enclosing mesh does not "
+                    "bind — fix the axis_name or the mesh")
+        elif "vma" in low or "varying" in low or "replicat" in low:
+            # modern jax's own vma checker rejected the program — same
+            # defect class as the analyzer's static replication inference
+            rule = "unreduced-gradient.trace-error"
+            hint = ("jax's vma checker refused the program: a value claimed "
+                    "replicated still varies — add the missing reduction")
+        first = msg.splitlines()[0] if msg.strip() else "<no message>"
+        return Report(name=name, findings=[Finding(
+            rule=rule, severity=Severity.ERROR,
+            message=f"tracing failed: {type(e).__name__}: {first}",
+            where=name, hint=hint)])
+    return analyze_jaxpr(jaxpr, mesh=mesh, name=name)

@@ -1,0 +1,118 @@
+"""CLI for the static analyzer — the standalone preflight gate.
+
+Examples::
+
+    # lint the exact steps dryrun_multichip(8) executes (CI runs 1..10)
+    python -m simple_distributed_machine_learning_tpu.analysis --dryrun 8
+
+    # run one seeded-defect fixture (exits non-zero when it flags, which a
+    # defect fixture always must)
+    python -m simple_distributed_machine_learning_tpu.analysis \
+        --fixture dropped_grad_sync
+
+    # self-test every fixture against its contract (defects flag, cleans
+    # pass) — the CI lint job's other half
+    python -m simple_distributed_machine_learning_tpu.analysis --fixtures
+
+Exit code: 0 when every analyzed step satisfies ``--fail-on`` (default:
+``warning`` for fixtures — a demonstration must demonstrate — and ``error``
+for ``--dryrun``/preflights, where e.g. a deliberate-bf16 dtype warning must
+not block a launch).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _bootstrap_devices(n: int) -> None:
+    """Virtual-CPU backend, same dance as __graft_entry__/tests: must run
+    before the first jax operation; keep whatever exists if backends are
+    already up (in-process callers)."""
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        from simple_distributed_machine_learning_tpu.parallel.compat import (
+            set_host_device_count,
+        )
+        set_host_device_count(n)
+    except RuntimeError:
+        pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m simple_distributed_machine_learning_tpu.analysis",
+        description="static sharding & collective analyzer (preflight gate)")
+    p.add_argument("--dryrun", type=int, default=None, metavar="N",
+                   help="analyze the steps dryrun_multichip(N) executes on "
+                        "an N-virtual-device mesh")
+    p.add_argument("--fixture", default=None, metavar="NAME",
+                   help="run one seeded fixture (see --list)")
+    p.add_argument("--fixtures", action="store_true",
+                   help="self-test every fixture against its contract")
+    p.add_argument("--list", action="store_true",
+                   help="list fixtures and rule families")
+    p.add_argument("--fail-on", choices=("error", "warning"), default=None,
+                   help="finding severity that makes the exit code non-zero "
+                        "(default: warning for fixtures, error for --dryrun)")
+    p.add_argument("--costs", action="store_true",
+                   help="print the bytes-over-ICI cost table per step")
+    args = p.parse_args(argv)
+
+    from simple_distributed_machine_learning_tpu.analysis.fixtures import (
+        FIXTURES,
+        self_test,
+    )
+
+    if args.list:
+        print("rule families: ppermute-deadlock unreduced-gradient "
+              "mesh-axis dtype-drift donation")
+        print("fixtures:")
+        for fx in FIXTURES.values():
+            kind = "defect" if fx.defect else "clean"
+            print(f"  {fx.name:<24} [{kind:>6}] {fx.description}")
+        return 0
+
+    if args.fixtures:
+        _bootstrap_devices(8)
+        ok, text = self_test()
+        print(text)
+        print(f"fixture self-test: {'OK' if ok else 'FAILED'}")
+        return 0 if ok else 1
+
+    if args.fixture is not None:
+        if args.fixture not in FIXTURES:
+            p.error(f"unknown fixture {args.fixture!r} (see --list)")
+        _bootstrap_devices(8)
+        report = FIXTURES[args.fixture].build()
+        print(report.format(costs=args.costs))
+        fail_on = args.fail_on or "warning"
+        return 0 if report.ok(fail_on) else 1
+
+    if args.dryrun is not None:
+        if args.dryrun < 1:
+            p.error(f"--dryrun needs a positive device count, got "
+                    f"{args.dryrun}")
+        _bootstrap_devices(args.dryrun)
+        from simple_distributed_machine_learning_tpu.analysis.preflight import (
+            all_ok,
+            dryrun_reports,
+        )
+        reports = dryrun_reports(args.dryrun)
+        for r in reports:
+            print(r.format(costs=args.costs))
+        fail_on = args.fail_on or "error"
+        ok = all_ok(reports, fail_on)
+        print(f"analysis --dryrun {args.dryrun}: "
+              f"{len(reports)} steps {'clean' if ok else 'FLAGGED'}")
+        return 0 if ok else 1
+
+    p.error("nothing to do: pass --dryrun N, --fixture NAME, --fixtures "
+            "or --list")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,269 @@
+"""Seeded-defect fixtures: programs the analyzer MUST flag (and clean twins
+it must not).
+
+Each fixture is a tiny, deliberately broken distributed step built the same
+way the engine builds real ones (``compat.shard_map`` over a named mesh) —
+one per rule family, mirroring the ways a hand-written stage fn actually
+goes wrong: a ring permutation that skips the wraparound hop, a
+data-parallel update that forgets the gradient all-reduce, a collective
+over a misspelled axis, a bf16 running sum, a buffer read after donation.
+
+``tests/test_analysis.py`` asserts every defect fixture produces a finding
+of its family and every ``defect=False`` twin analyzes clean; the CLI's
+``--fixtures`` self-test mode re-runs the same contract from the command
+line (non-zero exit when any fixture misbehaves), which is what the CI lint
+job invokes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from simple_distributed_machine_learning_tpu.analysis import Report, analyze
+
+
+@dataclasses.dataclass(frozen=True)
+class Fixture:
+    name: str
+    family: str              # rule family expected in the findings
+    defect: bool             # True: must flag; False: must be clean
+    description: str
+    build: Callable[[], Report]
+
+
+def _devs(n: int):
+    import jax
+    devices = jax.devices()
+    if len(devices) < n:
+        raise SystemExit(
+            f"fixture needs {n} devices, have {len(devices)} (run under "
+            f"xla_force_host_platform_device_count)")
+    import numpy as np
+    return np.array(devices[:n])
+
+
+def _mesh(n: int, axis: str = "data"):
+    from jax.sharding import Mesh
+    return Mesh(_devs(n), (axis,))
+
+
+# -- ppermute-deadlock: a ring missing its wraparound hop ------------------
+
+def partial_ppermute() -> Report:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        shard_map,
+    )
+
+    mesh = _mesh(4)
+
+    def shift(x):
+        # BUG: [(j, j+1)] without the (3, 0) wraparound — not a bijection;
+        # device 0 receives from nobody, device 3's send has no pair
+        return lax.ppermute(x, "data", [(0, 1), (1, 2), (2, 3)])
+
+    fn = jax.jit(shard_map(shift, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data"), check_vma=False))
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    return analyze(fn, x, mesh=mesh, name="fixture:partial_ppermute")
+
+
+# -- unreduced-gradient: data-parallel SGD missing the grad psum -----------
+
+def _dp_sgd_report(sync: bool, name: str) -> Report:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        shard_map,
+    )
+
+    mesh = _mesh(4)
+
+    def step(w, x):
+        def loss(w):
+            return jnp.mean((x @ w) ** 2)
+        g = jax.grad(loss)(w)
+        if sync:
+            g = lax.pmean(g, "data")
+        # else BUG: each data shard applies only ITS batch shard's gradient
+        # while the out_spec claims the replicas stay identical
+        return w - 0.1 * g
+
+    # check_vma=False: the engines this analyzer preflights run check-free
+    # (old-jax compat), so the missing reduction must be caught HERE, not by
+    # modern jax's own trace-time checker
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=(P(), P("data")),
+                           out_specs=P(), check_vma=False))
+    w = jax.ShapeDtypeStruct((16, 4), jnp.float32)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    return analyze(fn, w, x, mesh=mesh, name=name)
+
+
+def dropped_grad_sync() -> Report:
+    return _dp_sgd_report(False, "fixture:dropped_grad_sync")
+
+
+def clean_grad_sync() -> Report:
+    return _dp_sgd_report(True, "fixture:clean_grad_sync")
+
+
+# -- mesh-axis: collective over an axis the mesh does not bind -------------
+
+def wrong_axis_name() -> Report:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        shard_map,
+    )
+
+    mesh = _mesh(4)          # axes: ('data',)
+
+    def reduce(x):
+        # BUG: the mesh has no 'model' axis — a TP stage fn pasted into a
+        # data-parallel launch
+        return lax.psum(x, "model")
+
+    fn = jax.jit(shard_map(reduce, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data"), check_vma=False))
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    return analyze(fn, x, mesh=mesh, name="fixture:wrong_axis_name")
+
+
+# -- dtype-drift: bf16 psum into a bf16 scan accumulator -------------------
+
+def bf16_psum_accumulator() -> Report:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        shard_map,
+    )
+
+    mesh = _mesh(4)
+
+    def accumulate(xs):
+        def body(acc, x_t):
+            # BUG x2: the cross-device reduction runs in bf16, and the
+            # running sum is carried in bf16 — increments vanish once the
+            # sum outgrows 256x the step size
+            return acc + jnp.sum(lax.psum(x_t, "data"), axis=0), ()
+
+        acc0 = jnp.zeros((16,), jnp.bfloat16)
+        acc, _ = lax.scan(body, acc0, xs)
+        return acc
+
+    fn = jax.jit(shard_map(accumulate, mesh=mesh, in_specs=P(None, "data"),
+                           out_specs=P(), check_vma=False))
+    xs = jax.ShapeDtypeStruct((32, 8, 16), jnp.bfloat16)
+    return analyze(fn, xs, mesh=mesh, name="fixture:bf16_psum_accumulator")
+
+
+# -- donation: buffer read after being donated -----------------------------
+
+def read_after_donate() -> Report:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(buf, grads):
+        return buf - 0.1 * grads
+
+    def two_phase(buf, grads):
+        new_buf = update(buf, grads)
+        # BUG: the old buffer was donated to update() — its pages may
+        # already back new_buf; this read is use-after-free on device
+        drift = jnp.sum(new_buf - buf)
+        return new_buf, drift
+
+    b = jax.ShapeDtypeStruct((1024,), jnp.float32)
+    g = jax.ShapeDtypeStruct((1024,), jnp.float32)
+    return analyze(two_phase, b, g, name="fixture:read_after_donate")
+
+
+# -- clean twin: a full pipeline train step must produce zero findings -----
+
+def clean_pipeline_step() -> Report:
+    import jax
+
+    from simple_distributed_machine_learning_tpu.analysis.preflight import (
+        _abstract_batch,
+        abstractify,
+    )
+    from simple_distributed_machine_learning_tpu.models.mlp import (
+        make_mlp_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+    from simple_distributed_machine_learning_tpu.train.optimizer import sgd
+    from simple_distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+    )
+
+    stages, wire, out = make_mlp_stages(jax.random.key(0), [16, 16, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=2, devices=jax.devices()[:4])
+    pipe = Pipeline(stages, mesh, wire, out, n_microbatches=2)
+    opt = sgd(0.1, momentum=0.5)
+    buf = abstractify(pipe.init_params())
+    state = jax.eval_shape(opt.init, buf)
+    x, t, k = _abstract_batch(pipe, 8, 16)
+    return analyze(make_train_step(pipe, opt), buf, state, x, t, k,
+                   mesh=mesh, name="fixture:clean_pipeline_step")
+
+
+FIXTURES: dict[str, Fixture] = {f.name: f for f in [
+    Fixture("partial_ppermute", "ppermute-deadlock", True,
+            "ring permutation missing its wraparound hop", partial_ppermute),
+    Fixture("dropped_grad_sync", "unreduced-gradient", True,
+            "data-parallel update without the gradient all-reduce",
+            dropped_grad_sync),
+    Fixture("wrong_axis_name", "mesh-axis", True,
+            "psum over an axis the mesh does not bind", wrong_axis_name),
+    Fixture("bf16_psum_accumulator", "dtype-drift", True,
+            "bf16 cross-device reduction into a bf16 scan carry",
+            bf16_psum_accumulator),
+    Fixture("read_after_donate", "donation", True,
+            "buffer read after being donated to a jitted update",
+            read_after_donate),
+    Fixture("clean_grad_sync", "", False,
+            "the dropped_grad_sync fixture with the pmean restored",
+            clean_grad_sync),
+    Fixture("clean_pipeline_step", "", False,
+            "a 2-stage dp=2 GPipe train step (must be clean)",
+            clean_pipeline_step),
+]}
+
+
+def self_test() -> tuple[bool, str]:
+    """Run every fixture against its contract. Returns (ok, report_text) —
+    the CLI ``--fixtures`` mode prints the text and exits 0 iff ok."""
+    lines = []
+    ok = True
+    for fx in FIXTURES.values():
+        report = fx.build()
+        flagged = not report.ok(fail_on="warning")
+        family_hit = (not fx.defect or
+                      any(f.family == fx.family for f in report.findings))
+        good = (flagged and family_hit) if fx.defect else not flagged
+        ok = ok and good
+        verdict = "OK" if good else "FIXTURE CONTRACT VIOLATED"
+        want = (f"must flag [{fx.family}]" if fx.defect else "must be clean")
+        lines.append(f"== {fx.name}: {want} -> {verdict}")
+        lines.append(report.format(costs=False))
+    return ok, "\n".join(lines)

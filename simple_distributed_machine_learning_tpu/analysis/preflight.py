@@ -1,0 +1,237 @@
+"""Preflight glue: lint the exact step a launch is about to execute.
+
+``cli.py --lint`` and ``bench.py --lint`` call into here. Everything is
+abstract (ShapeDtypeStructs) — a preflight never allocates device buffers or
+runs a FLOP, so gating a 1000-chip launch on it costs trace time only.
+
+Also home of the pure-Python spec validators bench routes its ``--tp`` /
+``--overlap`` flags through, so an invalid combination (a chunk count that
+does not divide the model axis) exits with one clear message instead of a
+trace-time stack.
+"""
+
+from __future__ import annotations
+
+from simple_distributed_machine_learning_tpu.analysis import (
+    Report,
+    abstractify,
+    analyze,
+)
+
+
+def validate_tp_overlap(tp: int, overlap: str, n_devices: int | None = None,
+                        cfg=None, batch: int | None = None, n_micro: int = 1,
+                        ) -> tuple[list[str], list[str]]:
+    """Validate a tensor-parallel/overlap spec BEFORE building the model.
+
+    Returns ``(errors, warnings)``: errors make the combo untraceable or
+    wrong (exit with the message); warnings mean the ring schedule silently
+    degrades to the monolithic collective (``ring_psum``'s divisibility
+    fallback) — the run is correct but measures nothing new.
+
+    ``cfg`` is a ``GPTConfig``-shaped object (``d_model``/``n_heads``/
+    ``mlp_ratio``/``seq_len``/``attn_impl``/``n_experts`` attributes);
+    ``batch``/``n_micro`` let the token-axis chunking of the scattered MLP
+    (``matmul_reducescatter`` rows) be checked too.
+    """
+    errors: list[str] = []
+    warnings: list[str] = []
+    if tp < 1:
+        errors.append(f"--tp must be >= 1, got {tp}")
+        return errors, warnings
+    if overlap not in ("none", "ring", None):
+        errors.append(f"--overlap must be none|ring, got {overlap!r}")
+    if overlap == "ring" and tp < 2:
+        errors.append("--overlap ring needs --tp >= 2 (there is no "
+                      "collective to schedule on an unsharded row)")
+    if n_devices is not None and tp > n_devices:
+        errors.append(f"--tp {tp} needs {tp} devices, have {n_devices}")
+    if cfg is not None and tp > 1:
+        heads = getattr(cfg, "n_heads", None)
+        d_model = getattr(cfg, "d_model", None)
+        ratio = getattr(cfg, "mlp_ratio", 4)
+        if heads is not None and heads % tp:
+            errors.append(
+                f"--tp {tp} does not divide n_heads={heads}: attention "
+                f"shards by head, so heads per shard must be integral")
+        if d_model is not None and (ratio * d_model) % tp:
+            errors.append(
+                f"--tp {tp} does not divide the MLP hidden width "
+                f"{ratio}*{d_model}={ratio * d_model}: the column-parallel "
+                f"chunk count must divide the model axis")
+        if getattr(cfg, "attn_impl", "dense") not in ("dense", None) and tp > 1:
+            errors.append(
+                f"--tp shards attention by head with dense local math; "
+                f"attn={getattr(cfg, 'attn_impl', None)!r} is not "
+                f"composable with it")
+        if getattr(cfg, "n_experts", 0) and tp > 1:
+            errors.append("--tp cannot combine with MoE experts (a stage is "
+                          "tensor- OR expert-sharded, not both)")
+        if overlap == "ring":
+            if d_model is not None and d_model % tp:
+                warnings.append(
+                    f"ring overlap: d_model={d_model} not divisible by "
+                    f"tp={tp} — the attention projection's ring_psum falls "
+                    f"back to the monolithic psum (correct, no overlap)")
+            seq_len = getattr(cfg, "seq_len", None)
+            if batch is not None and seq_len is not None:
+                tokens = (batch // max(1, n_micro)) * seq_len
+                if tokens % tp:
+                    warnings.append(
+                        f"ring overlap: {tokens} tokens per microbatch not "
+                        f"divisible by tp={tp} — the scattered MLP falls "
+                        f"back to allgather + monolithic psum")
+    return errors, warnings
+
+
+def lint_step(fn, *args, mesh=None, name: str = "step") -> Report:
+    """Analyze ``fn`` on (abstractified) example args against ``mesh``."""
+    return analyze(fn, *[abstractify(a) for a in args], mesh=mesh, name=name)
+
+
+def lint_trainer(trainer, batch_size: int | None = None) -> Report:
+    """Lint the EXACT compiled train + eval steps a ``Trainer`` is about to
+    run: same pipeline, same optimizer, same donation, same batch shapes.
+    """
+    import jax
+    import numpy as np
+
+    pipe = trainer.pipe
+    B = int(batch_size or trainer.config.batch_size)
+    key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+    buf = abstractify(trainer.buf)
+    opt_state = abstractify(trainer.opt_state)
+    x = jax.ShapeDtypeStruct((B,) + tuple(trainer.train_ds.x.shape[1:]),
+                             np.float32)
+    tgt = jax.ShapeDtypeStruct((B,) + tuple(trainer.train_ds.y.shape[1:]),
+                               np.int32)
+    report = analyze(trainer._train_step, buf, opt_state, x, tgt, key,
+                     mesh=pipe.mesh, name="train_step")
+    n_valid = jax.ShapeDtypeStruct((), np.int32)
+    report.extend(analyze(trainer._eval_step, buf, x, tgt, key, n_valid,
+                          mesh=pipe.mesh, name="eval_step"))
+    report.name = "train_step + eval_step"
+    return report
+
+
+def _abstract_batch(pipe, batch: int, in_dim: int):
+    import jax
+    import numpy as np
+    x = jax.ShapeDtypeStruct((batch, in_dim), np.float32)
+    t = jax.ShapeDtypeStruct((batch,) + pipe.out_shape[:-1], np.int32)
+    key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+    return x, t, key
+
+
+def dryrun_reports(n_devices: int) -> list[Report]:
+    """Analyze the steps ``__graft_entry__.dryrun_multichip(n)`` executes:
+    the GPipe train step on the same dp x pp x tp mesh split, the
+    memory-flat eval, the ZeRO-1 + AdamW step when the mesh has a data
+    axis, and the 1F1B step where >= 2 stages fit. One Report per step —
+    the CI lint gate requires every one of them clean.
+    """
+    import jax
+
+    from simple_distributed_machine_learning_tpu.models.mlp import (
+        make_mlp_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+        make_mlp_tp_stages,
+    )
+    from simple_distributed_machine_learning_tpu.train import schedules
+    from simple_distributed_machine_learning_tpu.train.optimizer import (
+        adamw,
+        clip_by_global_norm,
+        sgd,
+        shard_opt_state_zero1,
+    )
+    from simple_distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+    )
+
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise SystemExit(
+            f"analysis --dryrun {n_devices}: need {n_devices} devices, have "
+            f"{len(devices)} (run under xla_force_host_platform_device_count)")
+    key = jax.random.key(0)
+    # identical topology selection to __graft_entry__.dryrun_multichip
+    if n_devices % 8 == 0:
+        n_stages, n_model = 2, 2
+        n_data = n_devices // (n_stages * n_model)
+        stages, wire_dim, out_dim = make_mlp_tp_stages(
+            key, [16, 16, 16, 16, 10], n_stages, n_model)
+        dims0 = 16
+    else:
+        n_stages = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+        n_model = 1
+        n_data = n_devices // n_stages
+        dims = [16] * n_stages + [10]
+        stages, wire_dim, out_dim = make_mlp_stages(key, dims, n_stages)
+        dims0 = dims[0]
+    mesh = make_mesh(n_stages=n_stages, n_data=n_data, n_model=n_model,
+                     devices=devices[:n_devices])
+    n_micro = 2
+    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=n_micro)
+    buf = abstractify(pipe.init_params())
+    opt = sgd(0.1, momentum=0.5)
+    opt_state = jax.eval_shape(opt.init, buf)
+    step = make_train_step(pipe, opt)
+    batch = 2 * n_micro * n_data
+    x, t, k = _abstract_batch(pipe, batch, dims0)
+    tag = f"{n_devices}dev dp={n_data} pp={n_stages} tp={n_model}"
+    reports = [
+        analyze(step, buf, opt_state, x, t, k, mesh=mesh,
+                name=f"train_step[{tag}]"),
+        analyze(jax.jit(pipe.eval_metrics), buf, x, t, k, mesh=mesh,
+                name=f"eval_metrics[{tag}]"),
+    ]
+
+    if n_data > 1:
+        opt_a = adamw(1e-3)
+        st_a = jax.eval_shape(
+            lambda b: shard_opt_state_zero1(opt_a.init(b), mesh,
+                                            pipe.param_spec()), buf)
+        step_a = make_train_step(pipe, opt_a)
+        reports.append(analyze(step_a, buf, st_a, x, t, k, mesh=mesh,
+                               name=f"zero1_adamw_step[{tag}]"))
+
+    fb_stages = 2 if n_devices % 2 == 0 else 1
+    if fb_stages >= 2:
+        if n_devices % 8 == 0:
+            fb_model = 2
+            fstages, fwire, fout = make_mlp_tp_stages(
+                key, [16, 16, 16, 16, 10], fb_stages, fb_model)
+        else:
+            fb_model = 1
+            fstages, fwire, fout = make_mlp_stages(key, [16, 16, 10],
+                                                   fb_stages)
+        fb_data = n_devices // (fb_stages * fb_model)
+        fmesh = make_mesh(n_stages=fb_stages, n_data=fb_data,
+                          n_model=fb_model, devices=devices[:n_devices])
+        fpipe = Pipeline(fstages, fmesh, fwire, fout, n_microbatches=2,
+                         schedule="1f1b")
+        fopt = clip_by_global_norm(
+            sgd(schedules.warmup_cosine(0.1, 2, 20), 0.5), 1.0,
+            fpipe.replication_weights())
+        fbuf = abstractify(fpipe.init_params())
+        fstate = jax.eval_shape(fopt.init, fbuf)
+        fstep = make_train_step(fpipe, fopt)
+        fx, ft, fk = _abstract_batch(fpipe, 4 * fb_data, 16)
+        reports.append(analyze(
+            fstep, fbuf, fstate, fx, ft, fk, mesh=fmesh,
+            name=f"1f1b_step[{n_devices}dev dp={fb_data} pp={fb_stages} "
+                 f"tp={fb_model}]"))
+    return reports
+
+
+def format_reports(reports: list[Report], costs: bool = False) -> str:
+    return "\n".join(r.format(costs=costs) for r in reports)
+
+
+def all_ok(reports: list[Report], fail_on: str = "error") -> bool:
+    return all(r.ok(fail_on) for r in reports)

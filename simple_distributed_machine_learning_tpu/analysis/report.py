@@ -1,0 +1,147 @@
+"""Structured findings for the static analyzer.
+
+A lint pass over a traced step produces :class:`Finding`s — (severity, rule
+id, provenance, message, fix hint) — plus a per-collective ICI cost table
+(:class:`CollectiveCost`). :class:`Report` aggregates both and owns the
+exit-code policy: ``ok(fail_on)`` is what the CLI / ``--lint`` preflights
+key off.
+
+Rule ids are ``family.check`` — the family is the coarse bucket the ISSUE /
+docs tables use (``ppermute-deadlock``, ``unreduced-gradient``, ``mesh-axis``,
+``dtype-drift``, ``donation``), the check names the specific defect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class Severity(enum.IntEnum):
+    """Ordered so ``max()`` over findings is the report's worst finding."""
+    INFO = 1
+    WARNING = 2
+    ERROR = 3
+
+    def __str__(self) -> str:  # "ERROR" not "Severity.ERROR" in reports
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect (or hazard) the analyzer can point at an equation.
+
+    ``where`` is the provenance path — the chain of enclosing call /
+    control-flow equations down to the offending one (plus the user source
+    line when jax recorded one) — so a finding inside
+    ``shard_map/scan/cond[branch 2]`` reads as exactly that.
+    """
+    rule: str                 # "family.check", e.g. "ppermute-deadlock.partial-perm"
+    severity: Severity
+    message: str              # what is wrong, with the concrete axis/shape/dtype
+    where: str = ""           # eqn provenance path + source line
+    hint: str = ""            # how to fix it
+
+    @property
+    def family(self) -> str:
+        return self.rule.split(".", 1)[0]
+
+    def format(self) -> str:
+        loc = f"\n    at {self.where}" if self.where else ""
+        fix = f"\n    fix: {self.hint}" if self.hint else ""
+        return f"[{self.severity}] {self.rule}: {self.message}{loc}{fix}"
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCost:
+    """Bytes-over-ICI estimate for one collective equation.
+
+    ``bytes_per_call`` is the operand payload; ``ici_bytes`` applies the
+    standard ring-algorithm traffic factor for the collective kind over an
+    axis group of ``group_size`` devices (psum ``2(n-1)/n``, all_gather
+    ``n-1`` x shard, reduce_scatter / all_to_all ``(n-1)/n``, ppermute
+    ``1``); ``trips`` is the static trip count of enclosing scans, so the
+    table ranks collectives by what they actually move per step.
+    """
+    prim: str
+    axes: tuple[str, ...]
+    group_size: int
+    bytes_per_call: int
+    ici_bytes: int            # bytes_per_call x traffic factor, per trip
+    trips: int                # product of enclosing scan lengths
+    where: str = ""
+
+    @property
+    def total_bytes(self) -> int:
+        return self.ici_bytes * self.trips
+
+
+class Report:
+    """The result of one ``analyze()`` run: findings + ICI cost table."""
+
+    def __init__(self, name: str = "", findings=None, costs=None):
+        self.name = name
+        self.findings: list[Finding] = list(findings or [])
+        self.costs: list[CollectiveCost] = list(costs or [])
+
+    # -- aggregation ------------------------------------------------------
+
+    def extend(self, other: "Report") -> "Report":
+        self.findings.extend(other.findings)
+        self.costs.extend(other.costs)
+        return self
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity >= Severity.ERROR]
+
+    @property
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    def by_family(self, family: str) -> list[Finding]:
+        return [f for f in self.findings if f.family == family]
+
+    def ok(self, fail_on: str = "error") -> bool:
+        """``fail_on='error'``: only ERROR findings gate (the preflight
+        default — dtype-drift warnings on a deliberate bf16 run must not
+        block the launch). ``fail_on='warning'``: any WARNING+ gates (the
+        fixture/CI-demonstration mode)."""
+        threshold = (Severity.WARNING if fail_on == "warning"
+                     else Severity.ERROR)
+        return all(f.severity < threshold for f in self.findings)
+
+    # -- formatting -------------------------------------------------------
+
+    def format(self, costs: bool = True, top: int = 8) -> str:
+        head = f"analysis: {self.name}" if self.name else "analysis"
+        lines = [head]
+        if not self.findings:
+            lines.append("  no findings: clean")
+        for f in sorted(self.findings, key=lambda f: -f.severity):
+            lines.extend("  " + ln for ln in f.format().splitlines())
+        if costs and self.costs:
+            lines.append("  bytes over ICI per step (top collectives):")
+            ranked = sorted(self.costs, key=lambda c: -c.total_bytes)
+            for c in ranked[:top]:
+                axes = ",".join(c.axes) or "-"
+                lines.append(
+                    f"    {c.prim:<16} axis={axes:<8} group={c.group_size} "
+                    f"x{c.trips:<5} {_human_bytes(c.total_bytes):>10}  "
+                    f"{c.where}")
+            if len(ranked) > top:
+                rest = sum(c.total_bytes for c in ranked[top:])
+                lines.append(f"    ... {len(ranked) - top} more collectives, "
+                             f"{_human_bytes(rest)}")
+            total = sum(c.total_bytes for c in self.costs)
+            lines.append(f"    total: {_human_bytes(total)}")
+        return "\n".join(lines)
+
+
+def _human_bytes(n: int) -> str:
+    size = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if size < 1024 or unit == "GiB":
+            return f"{size:.1f}{unit}" if unit != "B" else f"{int(size)}B"
+        size /= 1024
+    return f"{size:.1f}GiB"

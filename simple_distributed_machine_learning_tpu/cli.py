@@ -179,6 +179,16 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--profile', type=str, default=None, metavar='DIR',
                    help="capture an XProf/TensorBoard trace of the whole run "
                         "into DIR")
+    g.add_argument('--lint', action='store_true',
+                   help="static-analysis preflight (analysis/): trace the "
+                        "exact compiled train+eval steps this run is about "
+                        "to execute and lint them (ppermute deadlocks, "
+                        "unreduced gradients, mesh-axis validity, dtype "
+                        "drift, donation hazards) before any device "
+                        "executes a step; abort on ERROR findings")
+    g.add_argument('--lint-only', action='store_true',
+                   help="run the --lint preflight and exit without "
+                        "training (exit 0 clean, 2 on ERROR findings)")
     g.add_argument('--peer-timeout', type=float, default=60.0,
                    help="multi-process dead-peer watchdog: abort with a "
                         "nonzero exit if a peer crashes or stops "
@@ -368,6 +378,20 @@ def _total_steps(args, train_ds) -> int:
 
 
 def _fit(args, trainer) -> None:
+    if args.lint or args.lint_only:
+        # the preflight gate: lint the EXACT compiled steps this trainer is
+        # about to execute (same pipeline, optimizer, donation and batch
+        # shapes) — zero FLOPs, no device buffers touched
+        from simple_distributed_machine_learning_tpu.analysis.preflight import (
+            lint_trainer,
+        )
+        report = lint_trainer(trainer)
+        trainer._print(report.format(costs=True))
+        if not report.ok():
+            raise SystemExit(2)
+        trainer._print("| --lint: preflight clean")
+        if args.lint_only:
+            return
     if args.eval_only:
         # evaluate the restored (or fresh-init, if no checkpoint) params
         # without training — the companion to --checkpoint-dir resume
