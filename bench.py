@@ -324,18 +324,20 @@ def measure(name: str, spec: dict, windows: int = 5,
     opt_state = opt.init(buf)
     step = make_scanned_train_step(pipe, opt, pool_steps=steps)
     key = jax.random.key(0)
+    # abstract shapes of the exact step being timed, captured BEFORE any
+    # donation: the static ICI-bytes gauge (telemetry/ici.py) traces on these
+    from simple_distributed_machine_learning_tpu.analysis import abstractify
+    step_sds = (abstractify(buf), abstractify(opt_state), abstractify(xs),
+                abstractify(ts), abstractify(key))
+    lint_report = None
     if lint:
         # preflight the EXACT scanned step about to be timed (same spec,
         # schedule, overlap, donation) — abstract trace only, no FLOPs
-        from simple_distributed_machine_learning_tpu.analysis import (
-            abstractify,
-            analyze,
-        )
-        report = analyze(step, abstractify(buf), abstractify(opt_state),
-                         abstractify(xs), abstractify(ts), abstractify(key),
-                         mesh=mesh, name=f"bench:{name}")
-        print(report.format(costs=True))
-        if not report.ok():
+        from simple_distributed_machine_learning_tpu.analysis import analyze
+        lint_report = analyze(step, *step_sds, mesh=mesh,
+                              name=f"bench:{name}")
+        print(lint_report.format(costs=True))
+        if not lint_report.ok():
             raise SystemExit(2)
     jax.block_until_ready((xs, ts))
 
@@ -347,16 +349,27 @@ def measure(name: str, spec: dict, windows: int = 5,
         final_loss = float(losses[-1])            # forced device->host sync
         return time.perf_counter() - t0, final_loss, buf, opt_state
 
-    _, _, buf, opt_state = timed(1, buf, opt_state)          # compile + warm
+    t_compile, _, buf, opt_state = timed(1, buf, opt_state)  # compile + warm
     # paired two-point windows: (3 dispatches - 1 dispatch)/2 cancels every
     # fixed cost (dispatch, tunnel round-trip, the host read) within the SAME
     # pair; the median over pairs rejects tunnel-jitter outliers (taking
     # separate mins of t1/t2 across windows is biased when jitter ~ window)
+    #
+    # every per-window estimate also feeds a StepTimer histogram so rows
+    # report p50/p95/max per-step latency, not just the median-derived mean
+    from simple_distributed_machine_learning_tpu.telemetry.timer import (
+        StepTimer,
+    )
+    timer = StepTimer()
+    timer.record_window(t_compile, steps=1)      # the compile window
     diffs = []
     for _ in range(windows):
         d1, final_loss, buf, opt_state = timed(1, buf, opt_state)
         d3, final_loss, buf, opt_state = timed(3, buf, opt_state)
         diffs.append((d3 - d1) / 2)
+        if diffs[-1] > 0:                # negative = jitter swamped the pair
+            timer.record_window(diffs[-1], steps=steps,
+                                examples=steps * batch)
     diffs.sort()
     dt = diffs[len(diffs) // 2]
     if dt <= 0:
@@ -369,6 +382,24 @@ def measure(name: str, spec: dict, windows: int = 5,
     peak = PEAK_FLOPS.get(kind)
     achieved = sps * spec["flops"]     # aggregate FLOP/s across the pipeline
     n_chips = n_stages * n_model
+
+    # observability columns (telemetry/): per-step latency quantiles from
+    # the window histogram, the schedule-model pipeline bubble, and the
+    # statically expected collective bytes per step — bytes/step next to
+    # ms/step. All additive keys: the row schema only ever grows.
+    from simple_distributed_machine_learning_tpu.telemetry.bubble import (
+        schedule_bubble_fraction,
+    )
+    from simple_distributed_machine_learning_tpu.telemetry.ici import (
+        expected_ici_bytes,
+        from_report,
+    )
+    tstats = timer.summary()
+    # --lint already traced this exact step: reuse its cost table instead of
+    # paying the jaxpr trace a second time
+    ici_info = (from_report(lint_report, steps=steps) if lint_report is not None
+                else expected_ici_bytes(step, *step_sds, mesh=mesh,
+                                        name=f"bench:{name}", steps=steps))
     return {
         "config": name,
         "samples_per_sec": round(sps, 1),
@@ -392,6 +423,17 @@ def measure(name: str, spec: dict, windows: int = 5,
         "overlap": ((spec.get("overlap") or "none")
                     if spec["kind"] == "gpt" else None),
         "final_loss": round(final_loss, 4),
+        "step_ms_p50": tstats["step_time_ms_p50"],
+        "step_ms_p95": tstats["step_time_ms_p95"],
+        "step_ms_max": tstats["step_time_ms_max"],
+        "compile_s": round(t_compile, 3),
+        # schedule-model bubble of what actually RAN (pipe.n_stages and the
+        # degraded sched, not the requested flags); non-interleaved 1F1B
+        # shares GPipe's (S-1)/(M+S-1) — its win is activation memory
+        "bubble_fraction": round(schedule_bubble_fraction(
+            pipe.n_stages, pipe.n_microbatches, sched), 4),
+        "ici_bytes_per_step": (ici_info["ici_bytes_per_step"]
+                               if ici_info else None),
     }
 
 
@@ -768,6 +810,12 @@ def main() -> None:
             "optimizer": res["optimizer"],
             "tp": res["tp"],
             "overlap": res["overlap"],
+            # latency quantiles + bubble (telemetry/): p50/p95 say more than
+            # a mean on a jittery tunnel; bubble ranks schedule headroom
+            "step_ms_p50": res["step_ms_p50"],
+            "step_ms_p95": res["step_ms_p95"],
+            "bubble_fraction": res["bubble_fraction"],
+            "ici_bytes_per_step": res["ici_bytes_per_step"],
         }))
         if write_artifact:
             _write_results(partial=True)

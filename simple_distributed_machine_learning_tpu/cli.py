@@ -179,6 +179,24 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--profile', type=str, default=None, metavar='DIR',
                    help="capture an XProf/TensorBoard trace of the whole run "
                         "into DIR")
+    g.add_argument('--telemetry-dir', type=str, default=None, metavar='DIR',
+                   help="structured run telemetry (telemetry/): per-epoch "
+                        "metrics.jsonl (step-latency p50/p95, examples/sec "
+                        "and tokens/sec, live-array bytes, pipeline bubble "
+                        "fraction, expected ICI bytes/step), trace.json "
+                        "(Chrome-trace host spans for feed/step/eval — open "
+                        "in chrome://tracing or ui.perfetto.dev, no XProf "
+                        "needed) and metrics.prom (Prometheus text "
+                        "exposition) written into DIR")
+    g.add_argument('--telemetry-every', type=int, default=1, metavar='N',
+                   help="with --telemetry-dir: fence the device and sample "
+                        "step latency every Nth step; 1 = exact per-step "
+                        "latency, larger N keeps async dispatch overlapped "
+                        "and attributes each fenced window to its N steps")
+    g.add_argument('--dryrun', type=int, default=0, metavar='N',
+                   help="smoke mode: train only N batches of a single epoch "
+                        "(then the normal eval) and exit — the cheap "
+                        "end-to-end check CI pairs with --telemetry-dir")
     g.add_argument('--lint', action='store_true',
                    help="static-analysis preflight (analysis/): trace the "
                         "exact compiled train+eval steps this run is about "
@@ -272,6 +290,9 @@ def _dispatch(args) -> None:
     n_stages = args.stages if args.stages is not None else (2 if n_dev >= 2 else 1)
 
     key = jax.random.key(args.seed)
+    if args.dryrun < 0:
+        raise SystemExit(f"--dryrun needs a non-negative step count, got "
+                         f"{args.dryrun}")
     if args.tp > 1 and args.model not in ("mlp", "gpt"):
         raise SystemExit("--tp is only supported with --model=mlp or gpt")
     if args.sp > 1 and args.model != "gpt":
@@ -318,7 +339,6 @@ def _dispatch(args) -> None:
     from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
     from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
     from simple_distributed_machine_learning_tpu.train.trainer import (
-        TrainConfig,
         Trainer,
     )
 
@@ -327,16 +347,11 @@ def _dispatch(args) -> None:
                     n_microbatches=args.microbatches,
                     compute_dtype=_compute_dtype(args), remat=args.remat,
                     schedule=args.schedule, overlap=args.overlap)
-    config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
-                         learning_rate=args.lr, momentum=args.momentum,
-                         seed=args.seed, checkpoint_dir=args.checkpoint_dir,
-                         resume=not args.no_resume, zero1=args.zero1,
-                         async_checkpoint=args.async_checkpoint,
-                         shuffle=args.shuffle,
-                         metrics_json=args.metrics_json)
+    config = _train_config(args)
     _fit(args, Trainer(pipe, train_ds, test_ds, config,
                        opt=_make_opt(args, _total_steps(args, train_ds),
-                                     pipe)))
+                                     pipe),
+                       telemetry=_telemetry(args)))
 
 
 def _compute_dtype(args):
@@ -344,6 +359,33 @@ def _compute_dtype(args):
         return None
     import jax.numpy as jnp
     return jnp.bfloat16
+
+
+def _train_config(args):
+    from simple_distributed_machine_learning_tpu.train.trainer import (
+        TrainConfig,
+    )
+    return TrainConfig(
+        # --dryrun N: N batches of one epoch, the cheap end-to-end smoke
+        epochs=1 if args.dryrun else args.epochs,
+        max_steps_per_epoch=args.dryrun or None,
+        batch_size=args.batch_size,
+        learning_rate=args.lr, momentum=args.momentum,
+        seed=args.seed, checkpoint_dir=args.checkpoint_dir,
+        resume=not args.no_resume, zero1=args.zero1,
+        async_checkpoint=args.async_checkpoint,
+        shuffle=args.shuffle,
+        metrics_json=args.metrics_json)
+
+
+def _telemetry(args):
+    if not args.telemetry_dir:
+        return None
+    if args.telemetry_every < 1:
+        raise SystemExit(f"--telemetry-every must be >= 1, got "
+                         f"{args.telemetry_every}")
+    from simple_distributed_machine_learning_tpu.telemetry import Telemetry
+    return Telemetry(args.telemetry_dir, every=args.telemetry_every)
 
 
 def _make_opt(args, total_steps: int, pipe=None):
@@ -399,6 +441,8 @@ def _fit(args, trainer) -> None:
             trainer._print("| --eval-only: no checkpoint found, evaluating "
                            "fresh-initialized params")
         trainer.evaluate()
+        if trainer.telemetry is not None:
+            trainer.telemetry.close()    # eval spans -> trace.json
         return
     if args.profile:
         from simple_distributed_machine_learning_tpu.utils.profiler import trace
@@ -422,7 +466,6 @@ def _run_gpt(args, n_stages: int, key) -> None:
     from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
     from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
     from simple_distributed_machine_learning_tpu.train.trainer import (
-        TrainConfig,
         Trainer,
     )
 
@@ -469,16 +512,11 @@ def _run_gpt(args, n_stages: int, key) -> None:
                     n_microbatches=args.microbatches,
                     compute_dtype=_compute_dtype(args), remat=args.remat,
                     schedule=args.schedule, overlap=args.overlap)
-    config = TrainConfig(epochs=args.epochs, batch_size=args.batch_size,
-                         learning_rate=args.lr, momentum=args.momentum,
-                         seed=args.seed, checkpoint_dir=args.checkpoint_dir,
-                         resume=not args.no_resume, zero1=args.zero1,
-                         async_checkpoint=args.async_checkpoint,
-                         shuffle=args.shuffle,
-                         metrics_json=args.metrics_json)
+    config = _train_config(args)
     trainer = Trainer(pipe, train_ds, test_ds, config,
                       opt=_make_opt(args, _total_steps(args, train_ds),
-                                    pipe))
+                                    pipe),
+                      telemetry=_telemetry(args))
     _fit(args, trainer)
     if args.generate > 0:
         _print_sample(args, trainer, cfg, test_ds)

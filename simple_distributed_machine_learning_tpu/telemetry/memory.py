@@ -1,0 +1,71 @@
+"""Memory telemetry: live-array byte totals and per-device memory stats.
+
+Two complementary sources, both sampled per epoch (they walk every live
+buffer / query the runtime — not per-step material):
+
+- ``jax.live_arrays()`` — every ``jax.Array`` the process still references,
+  summed by ``nbytes``. This is the *program's* footprint (params, optimizer
+  state, pinned input pools) and works on every backend including the
+  virtual-CPU test meshes.
+- ``device.memory_stats()`` — the *runtime allocator's* view (``bytes_in_use``,
+  ``peak_bytes_in_use``, ...) where the backend exposes one (TPU/GPU do; CPU
+  returns nothing) — the number an OOM postmortem needs.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def live_array_bytes() -> int:
+    """Total bytes of every live ``jax.Array`` in the process."""
+    total = 0
+    try:
+        arrays = jax.live_arrays()
+    except Exception:  # noqa: BLE001 - introspection is strictly best-effort
+        return 0
+    for a in arrays:
+        try:
+            total += int(a.nbytes)
+        except Exception:  # noqa: BLE001 - deleted/donated buffers mid-walk
+            continue
+    return total
+
+
+def device_memory_stats() -> dict[str, dict]:
+    """``device id -> memory_stats()`` for devices that report any.
+
+    Values are left as the backend reports them (ints); backends without an
+    allocator report (XLA:CPU) simply contribute nothing.
+    """
+    out: dict[str, dict] = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 - plugin-dependent surface
+            stats = None
+        if stats:
+            out[str(d.id)] = {k: int(v) for k, v in stats.items()
+                              if isinstance(v, (int, float))}
+    return out
+
+
+def sample(registry=None) -> dict:
+    """One memory sample: returns the epoch-record block and mirrors it into
+    ``registry`` gauges (``live_array_bytes``; ``device_bytes_in_use`` and
+    ``device_peak_bytes_in_use`` labeled per device) when one is given."""
+    live = live_array_bytes()
+    per_dev = device_memory_stats()
+    if registry is not None:
+        registry.gauge("live_array_bytes").set(live)
+        for dev, stats in per_dev.items():
+            for key, gname in (("bytes_in_use", "device_bytes_in_use"),
+                               ("peak_bytes_in_use",
+                                "device_peak_bytes_in_use")):
+                if key in stats:
+                    registry.gauge(gname, labels={"device": dev}) \
+                        .set(stats[key])
+    rec = {"live_array_bytes": live}
+    if per_dev:
+        rec["device_memory"] = per_dev
+    return rec

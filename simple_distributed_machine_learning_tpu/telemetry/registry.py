@@ -1,0 +1,246 @@
+"""Labeled metric instruments: counters, gauges, histograms.
+
+The reference's only observability is a loss ``print`` every ``log_interval``
+batches; ``train/trainer.py`` grew a per-epoch JSONL append on top. This
+module replaces both ad-hoc paths with one registry: named, optionally
+labeled series that snapshot to a JSON record (the ``metrics.jsonl`` stream)
+and to a Prometheus-style text exposition (``metrics.prom``), so a run can
+feed dashboards without any scraping shim.
+
+Semantics (the subset of the Prometheus data model the trainer needs):
+
+- :class:`Counter` is monotonic — ``inc`` of a negative amount raises, so a
+  consumer may compute rates without guarding against resets mid-run;
+- :class:`Gauge` is a settable last-value;
+- :class:`Histogram` keeps exact weighted observations (bounded reservoir of
+  the most recent ``max_samples`` distinct observe calls) and answers
+  nearest-rank quantiles — p50/p95 step latency is the whole point;
+- two series with the same name must agree on instrument kind AND label-key
+  set (``registry.counter("steps"); registry.gauge("steps")`` is a bug, as is
+  the same name with different label keys) — :class:`MetricsRegistry` raises
+  on the collision instead of silently forking the series.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+class Counter:
+    """Monotonic counter. ``inc(n)`` with ``n < 0`` raises ``ValueError``."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: dict | None = None) -> None:
+        self.name = name
+        self.labels = dict(labels or {})
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name!r} is monotonic: inc({amount}) — use a "
+                f"Gauge for values that go down")
+        self.value += amount
+
+
+class Gauge:
+    """Last-value instrument."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: dict | None = None) -> None:
+        self.name = name
+        self.labels = dict(labels or {})
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Weighted observations with exact nearest-rank quantiles.
+
+    ``observe(v, n=k)`` records ``k`` observations of value ``v`` in O(1) —
+    the shape a windowed step timer needs (one fenced window covers ``k``
+    steps of identical estimated duration). ``count``/``sum``/``max`` cover
+    ALL observations; quantiles are computed over a bounded reservoir of the
+    most recent ``max_samples`` observe calls (a ring buffer — steady-state
+    training is stationary enough that recency beats reservoir sampling and
+    stays deterministic).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: dict | None = None,
+                 max_samples: int = 8192) -> None:
+        self.name = name
+        self.labels = dict(labels or {})
+        self.count = 0.0
+        self.sum = 0.0
+        self.max = None
+        self._ring: list[tuple[float, float]] = []   # (value, weight)
+        self._next = 0
+        self._max_samples = max_samples
+
+    def observe(self, value: float, n: float = 1.0) -> None:
+        if n <= 0:
+            raise ValueError(f"histogram {self.name!r}: observe weight {n} "
+                             f"must be positive")
+        value = float(value)
+        self.count += n
+        self.sum += value * n
+        self.max = value if self.max is None else max(self.max, value)
+        if len(self._ring) < self._max_samples:
+            self._ring.append((value, n))
+        else:
+            self._ring[self._next] = (value, n)
+            self._next = (self._next + 1) % self._max_samples
+
+    def quantile(self, q: float) -> float | None:
+        """Weighted nearest-rank quantile over the reservoir, ``q in [0,1]``."""
+        if not self._ring:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        pairs = sorted(self._ring)
+        total = sum(w for _, w in pairs)
+        target = q * total
+        cum = 0.0
+        for v, w in pairs:
+            cum += w
+            if cum >= target:
+                return v
+        return pairs[-1][0]
+
+    @property
+    def mean(self) -> float | None:
+        return self.sum / self.count if self.count else None
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": self.mean,
+            "max": self.max,
+            "p50": self.quantile(0.5),
+            "p95": self.quantile(0.95),
+        }
+
+
+class MetricsRegistry:
+    """Name -> instrument registry with label-series fan-out.
+
+    ``registry.counter("x", labels={"stage": "0"})`` returns the one live
+    instrument for that (name, labels) pair — repeated calls accumulate into
+    the same series. A name re-registered as a different kind or with a
+    different label-KEY set raises (a silent fork of the series is exactly
+    the observability bug this layer exists to prevent).
+    """
+
+    def __init__(self) -> None:
+        self._series: dict[tuple[str, tuple], Counter | Gauge | Histogram] = {}
+        self._schemas: dict[str, tuple[str, tuple[str, ...]]] = {}
+        self._lock = threading.Lock()
+
+    # -- instrument constructors ------------------------------------------
+
+    def counter(self, name: str, labels: dict | None = None) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, labels: dict | None = None) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, labels: dict | None = None,
+                  max_samples: int = 8192) -> Histogram:
+        return self._get(Histogram, name, labels, max_samples=max_samples)
+
+    def _get(self, cls, name: str, labels: dict | None, **kw):
+        labels = dict(labels or {})
+        key = (name, tuple(sorted(labels.items())))
+        label_keys = tuple(sorted(labels))
+        with self._lock:
+            schema = self._schemas.get(name)
+            if schema is not None and schema != (cls.kind, label_keys):
+                raise ValueError(
+                    f"metric {name!r} already registered as {schema[0]} with "
+                    f"label keys {schema[1]}; got {cls.kind} with "
+                    f"{label_keys} — one name, one schema")
+            inst = self._series.get(key)
+            if inst is None:
+                inst = cls(name, labels, **kw)
+                self._series[key] = inst
+                self._schemas[name] = (cls.kind, label_keys)
+            return inst
+
+    # -- export -----------------------------------------------------------
+
+    def instruments(self) -> list:
+        return list(self._series.values())
+
+    def snapshot(self) -> dict:
+        """JSON-serializable map ``name{k=v,...} -> value`` (histograms map
+        to their summary dict)."""
+        out = {}
+        for inst in self._series.values():
+            out[_series_key(inst)] = (inst.summary()
+                                      if isinstance(inst, Histogram)
+                                      else inst.value)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (histograms as summary quantiles)."""
+        lines = []
+        seen_type: set[str] = set()
+        for inst in sorted(self._series.values(), key=_series_key):
+            if inst.name not in seen_type:
+                seen_type.add(inst.name)
+                kind = "summary" if isinstance(inst, Histogram) else inst.kind
+                lines.append(f"# TYPE {inst.name} {kind}")
+            if isinstance(inst, Histogram):
+                for q in (0.5, 0.95):
+                    v = inst.quantile(q)
+                    if v is not None:
+                        lines.append(f"{inst.name}"
+                                     f"{_labels(inst.labels, quantile=q)} "
+                                     f"{_num(v)}")
+                lines.append(f"{inst.name}_count{_labels(inst.labels)} "
+                             f"{_num(inst.count)}")
+                lines.append(f"{inst.name}_sum{_labels(inst.labels)} "
+                             f"{_num(inst.sum)}")
+            else:
+                lines.append(f"{inst.name}{_labels(inst.labels)} "
+                             f"{_num(inst.value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _series_key(inst) -> str:
+    if not inst.labels:
+        return inst.name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(inst.labels.items()))
+    return f"{inst.name}{{{inner}}}"
+
+
+def _labels(labels: dict, **extra) -> str:
+    items = {**labels, **extra}
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+    return "{" + inner + "}"
+
+
+def _num(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def append_jsonl(path: str, record: dict, schema: int = 2) -> dict:
+    """Append one schema-versioned JSON line to ``path`` and return the full
+    record written. The ``schema`` key is injected first so consumers can
+    dispatch on it before touching any other field; an explicit ``schema``
+    already in ``record`` wins."""
+    full = {"schema": schema, "time": round(time.time(), 3), **record}
+    with open(path, "a") as f:
+        f.write(json.dumps(full) + "\n")
+    return full

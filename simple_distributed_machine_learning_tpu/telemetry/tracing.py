@@ -1,0 +1,87 @@
+"""Host-side spans exported as Chrome-trace JSON.
+
+``utils/profiler.annotate`` already names host intervals on an XProf
+timeline — but reading that timeline needs a TensorBoard/XProf install and a
+captured device trace. This module records the same spans host-side with
+wall-clock durations and writes the ``chrome://tracing`` / Perfetto JSON
+format, so every run with ``--telemetry-dir`` is timeline-inspectable with
+nothing but a browser.
+
+Each :meth:`Tracer.span` also enters ``profiler.annotate`` (a
+``jax.profiler.TraceAnnotation``), so when an XProf capture IS active the
+host spans land on both timelines with the same names.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+from simple_distributed_machine_learning_tpu.utils import profiler
+
+
+class Tracer:
+    """Collects completed spans; thread-safe; ``write`` emits Chrome JSON."""
+
+    def __init__(self, process_name: str = "sdml") -> None:
+        self._t0_ns = time.perf_counter_ns()
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._process_name = process_name
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0_ns) / 1e3
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """``with tracer.span("step", epoch=3): ...`` — one complete event.
+
+        Nesting is rendered by the viewer from ts/dur containment within the
+        thread's track; exceptions still close the span (the trace must show
+        the failing interval, not lose it).
+        """
+        t0 = self._now_us()
+        with profiler.annotate(name):
+            try:
+                yield self
+            finally:
+                t1 = self._now_us()
+                ev = {"name": name, "ph": "X", "ts": t0, "dur": t1 - t0,
+                      "pid": self._pid, "tid": threading.get_ident()}
+                if attrs:
+                    ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+                with self._lock:
+                    self._events.append(ev)
+
+    def instant(self, name: str, **attrs) -> None:
+        """A zero-duration marker (``ph: "i"``) — epoch boundaries etc."""
+        ev = {"name": name, "ph": "i", "s": "t", "ts": self._now_us(),
+              "pid": self._pid, "tid": threading.get_ident()}
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            self._events.append(ev)
+
+    def to_chrome_trace(self) -> dict:
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "tid": 0, "args": {"name": self._process_name}}]
+        with self._lock:
+            return {"traceEvents": meta + list(self._events),
+                    "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> str:
+        """Write the Chrome-trace JSON to ``path`` (atomic rename so a
+        reader never sees a torn file) and return the path."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+
+def _jsonable(v):
+    return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)

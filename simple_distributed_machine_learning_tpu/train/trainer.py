@@ -15,6 +15,7 @@ prints (``is_main``).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 
 import jax
@@ -77,8 +78,12 @@ class TrainConfig:
     # train_loss, samples_per_sec, eval_loss, accuracy — plus the raw
     # correct/n_eval counts the accuracy is computed from) appended to this
     # path by process 0. The console surface stays byte-identical to the
-    # reference; this is the structured counterpart (SURVEY §5.5).
+    # reference; this is the structured counterpart (SURVEY §5.5). Records
+    # are written through the telemetry registry and carry "schema": 2.
     metrics_json: str | None = None
+    # smoke/dryrun mode (cli.py --dryrun): train at most this many batches
+    # per epoch. None = the full dataset, the reference's behavior.
+    max_steps_per_epoch: int | None = None
 
 
 class Trainer:
@@ -86,11 +91,20 @@ class Trainer:
 
     def __init__(self, pipe: Pipeline, train_ds: Dataset, test_ds: Dataset,
                  config: TrainConfig | None = None,
-                 opt: Optimizer | None = None) -> None:
+                 opt: Optimizer | None = None, telemetry=None) -> None:
         self.pipe = pipe
         self.train_ds = train_ds
         self.test_ds = test_ds
         self.config = config or TrainConfig()
+        # the observability hook (telemetry/session.py): per-step latency
+        # sampling, feed/step/eval host spans, per-epoch metric emission.
+        # None = reference behavior (console + optional metrics_json only).
+        self.telemetry = telemetry
+        # LM datasets have [N, T] targets: telemetry reports tokens/sec
+        # alongside examples/sec (0 = classifier, no token throughput)
+        self._tokens_per_sample = (int(np.prod(train_ds.y.shape[1:]))
+                                   if np.ndim(train_ds.y) > 1 else 0)
+        self._registry = telemetry.registry if telemetry is not None else None
         self.opt = opt or sgd(self.config.learning_rate, self.config.momentum)
         self.buf = pipe.init_params()
         self.opt_state = self.opt.init(self.buf)
@@ -221,6 +235,7 @@ class Trainer:
 
     def train_epoch(self, epoch: int) -> float:
         cfg = self.config
+        tele = self.telemetry
         meter = Throughput()
         n_total = len(self.train_ds.x)
         n_batches = max(1, (n_total + cfg.batch_size - 1) // cfg.batch_size)
@@ -228,9 +243,14 @@ class Trainer:
         # batch assembly on the native C++ prefetcher thread when available
         # (transparent python fallback), overlapped with the device step
         shuffle_seed = (cfg.seed * 100003 + epoch) if cfg.shuffle else None
+        if tele is not None:
+            tele.mark()                  # window start = loop entry, not init
         for batch_idx, b in enumerate(
                 prefetch_batches(self.train_ds, cfg.batch_size,
                                  shuffle_seed=shuffle_seed)):
+            if (cfg.max_steps_per_epoch is not None
+                    and batch_idx >= cfg.max_steps_per_epoch):
+                break
             key = jax.random.fold_in(self._key, self._step_count)
             # ragged final batch: zero-padded, masked out of the loss mean
             # (the reference just trains on the short batch, :108-113; the
@@ -238,11 +258,37 @@ class Trainer:
             w = None
             if b.n_valid < len(b.x):
                 w = (np.arange(len(b.x)) < b.n_valid).astype(np.float32)
-            x, y, w = self._feed(b.x, b.y, w)
-            self.buf, self.opt_state, loss = self._train_step(
-                self.buf, self.opt_state, x, y, key, w)
+            with (tele.span("feed") if tele is not None
+                  else contextlib.nullcontext()):
+                x, y, w = self._feed(b.x, b.y, w)
+            if (tele is not None and batch_idx == 0
+                    and epoch == self.start_epoch):
+                # register the exact step + shapes for the static ICI-bytes
+                # gauge (trace-only; shapes captured BEFORE donation).
+                # Keyed on the run's first batch — not _step_count, which a
+                # checkpoint resume starts nonzero
+                from simple_distributed_machine_learning_tpu.analysis import (
+                    abstractify,
+                )
+                tele.set_step_probe(
+                    self._train_step, abstractify(self.buf),
+                    abstractify(self.opt_state), abstractify(x),
+                    abstractify(y), abstractify(key),
+                    abstractify(w) if w is not None else None,
+                    mesh=self.pipe.mesh)
+            with (tele.span("step") if tele is not None
+                  else contextlib.nullcontext()):
+                self.buf, self.opt_state, loss = self._train_step(
+                    self.buf, self.opt_state, x, y, key, w)
             self._step_count += 1
             meter.update(b.n_valid)
+            if tele is not None:
+                # the first batch of the run is forced: that window is the
+                # compile window and the StepTimer keeps it split out
+                tele.on_step(
+                    loss, examples=b.n_valid,
+                    tokens=b.n_valid * self._tokens_per_sample,
+                    force_fence=(batch_idx == 0))
             if batch_idx == 0:
                 # first step includes trace+compile; keep it out of the
                 # throughput window (the metric is chip throughput)
@@ -262,17 +308,20 @@ class Trainer:
 
     def evaluate(self) -> tuple[float, int]:
         cfg = self.config
+        tele = self.telemetry
         total_loss = 0.0
         correct = 0
         # prediction units: samples for classifiers (y: [N]), tokens for
         # language models (y: [N, T]) — y.size covers both
         n = int(self.test_ds.y.size)
         for b in batches(self.test_ds, cfg.batch_size, pad_last=True):
-            x, y, _ = self._feed(b.x, b.y, None)
-            sl, c = self._eval_step(self.buf, x, y, self._key,
-                                    np.int32(b.n_valid))
-            total_loss += float(sl)
-            correct += int(c)
+            with (tele.span("eval") if tele is not None
+                  else contextlib.nullcontext()):
+                x, y, _ = self._feed(b.x, b.y, None)
+                sl, c = self._eval_step(self.buf, x, y, self._key,
+                                        np.int32(b.n_valid))
+                total_loss += float(sl)      # host read closes the span at
+                correct += int(c)            # the batch's true end
         avg = total_loss / n
         self._print(
             '\nTest set: Average loss: {:.4f}, Accuracy: {}/{} ({:.0f}%)\n'
@@ -280,11 +329,33 @@ class Trainer:
         return avg, correct
 
     def _log_metrics(self, record: dict) -> None:
+        """Per-epoch metrics through the telemetry registry.
+
+        Every field is mirrored into registry instruments (monotonic
+        counters for step/correct counts, gauges for the rest) so the same
+        numbers ride the Prometheus exposition when telemetry is on; the
+        JSONL line keeps every documented key (``accuracy`` is the headline)
+        and is now schema-versioned (``"schema": 2`` — schema 1 was the bare
+        unversioned record).
+        """
+        from simple_distributed_machine_learning_tpu.telemetry.registry import (
+            append_jsonl,
+        )
+        reg = self._registry
+        if reg is not None:
+            # a Telemetry session is attached: its registry (and thus the
+            # Prometheus exposition) carries the training series too
+            steps = reg.counter("train_steps_total")
+            steps.inc(record["step"] - steps.value)
+            if record["correct"] is not None:
+                reg.counter("eval_correct_total").inc(record["correct"])
+            for key in ("train_loss", "eval_loss", "accuracy",
+                        "samples_per_sec"):
+                if record.get(key) is not None:
+                    reg.gauge(key).set(record[key])
         if not (self.config.metrics_json and self.is_main):
             return
-        import json
-        with open(self.config.metrics_json, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        append_jsonl(self.config.metrics_json, record, schema=2)
 
     def fit(self) -> None:
         """The reference's epoch driver (``simple_distributed.py:134-136``),
@@ -294,7 +365,7 @@ class Trainer:
             train_loss = self.train_epoch(epoch)
             eval_loss, correct = self.evaluate()
             n_eval = int(self.test_ds.y.size)
-            self._log_metrics({
+            record = {
                 "epoch": epoch,
                 "step": self._step_count,
                 "train_loss": round(train_loss, 6),
@@ -305,7 +376,15 @@ class Trainer:
                 "accuracy": round(correct / n_eval, 6) if n_eval else None,
                 "correct": correct,
                 "n_eval": n_eval,
-            })
+            }
+            self._log_metrics(record)
+            if self.telemetry is not None:
+                # the full per-epoch telemetry record: step-latency
+                # quantiles, throughput, memory, bubble estimate, ICI bytes
+                # — with the training record's fields riding along
+                self.telemetry.on_epoch(epoch, pipe=self.pipe, extra=record)
             self._save(epoch)
         if self._pending_save is not None:
             self._pending_save.wait()
+        if self.telemetry is not None:
+            self.telemetry.close()

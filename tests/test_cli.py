@@ -48,6 +48,37 @@ def test_cli_profile_writes_trace(tmp_path):
     assert found, "profiler produced no trace files"
 
 
+def test_cli_dryrun_telemetry_end_to_end(tmp_path):
+    """--dryrun N + --telemetry-dir: the cheap observability smoke CI runs —
+    N train batches, eval, and a parseable metrics/trace/prom artifact set."""
+    import json
+    import os
+
+    tele = str(tmp_path / "tele")
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--mlp-dims", "784,32,10", "--stages", "2", "--epochs", "5",
+          "--dryrun", "2", "--microbatches", "2",
+          "--data-root", "/nonexistent", "--telemetry-dir", tele])
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(tele, "metrics.jsonl")).read().splitlines()]
+    assert len(recs) == 1                   # --dryrun forces a single epoch
+    r = recs[0]
+    assert r["schema"] == 2 and r["steps"] == 1     # 2 batches - compile
+    assert r["step_time_ms_p50"] > 0 and r["step_time_ms_p95"] > 0
+    assert r["examples_per_sec"] > 0
+    assert r["live_array_bytes"] > 0
+    assert r["ici_bytes_per_step"] > 0      # 2-stage pipeline: ppermute hops
+    trace = json.load(open(os.path.join(tele, "trace.json")))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"feed", "step", "eval"} <= names
+    assert os.path.exists(os.path.join(tele, "metrics.prom"))
+
+
+def test_cli_dryrun_rejects_negative():
+    with pytest.raises(SystemExit, match="--dryrun"):
+        main(["--rank", "0", "--model", "mlp", "--dryrun", "-1"])
+
+
 def test_cli_adamw_zero1(capsys):
     """--optimizer adamw --zero1 end to end through the CLI."""
     main(["--rank", "0", "--world_size", "1", "--model", "mlp",

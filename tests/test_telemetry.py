@@ -1,0 +1,367 @@
+"""telemetry/: registry semantics, StepTimer compile-vs-steady split,
+Chrome-trace well-formedness, bubble model, the static ICI gauge, the
+hardened profiler.trace, and a Trainer smoke run with a full session."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu import telemetry as tm
+from simple_distributed_machine_learning_tpu.data.mnist import (
+    Dataset,
+    synthetic_mnist,
+)
+from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages
+from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+from simple_distributed_machine_learning_tpu.train.trainer import (
+    TrainConfig,
+    Trainer,
+)
+
+
+# -- registry -------------------------------------------------------------
+
+def test_counter_is_monotonic():
+    reg = tm.MetricsRegistry()
+    c = reg.counter("steps")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError, match="monotonic"):
+        c.inc(-1)
+    assert c.value == 5
+    # same (name, labels) -> the same live instrument, not a fork
+    assert reg.counter("steps") is c
+
+
+def test_gauge_and_snapshot_roundtrip():
+    reg = tm.MetricsRegistry()
+    reg.gauge("loss").set(1.5)
+    reg.counter("n", labels={"stage": "0"}).inc(3)
+    snap = reg.snapshot()
+    assert snap["loss"] == 1.5
+    assert snap["n{stage=0}"] == 3
+    json.loads(json.dumps(snap))            # JSON-serializable as claimed
+
+
+def test_histogram_quantiles_nearest_rank():
+    h = tm.Histogram("lat")
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.quantile(0.5) == 50.0
+    assert h.quantile(0.95) == 95.0
+    assert h.quantile(0.0) == 1.0 and h.quantile(1.0) == 100.0
+    assert h.max == 100.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_weighted_observations():
+    h = tm.Histogram("lat")
+    h.observe(10.0, n=99)                   # one fenced window, 99 steps
+    h.observe(1000.0, n=1)                  # one straggler
+    assert h.count == 100
+    assert h.quantile(0.5) == 10.0
+    assert h.quantile(0.95) == 10.0
+    assert h.max == 1000.0
+    with pytest.raises(ValueError):
+        h.observe(1.0, n=0)
+
+
+def test_label_collisions_raise():
+    reg = tm.MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="one name, one schema"):
+        reg.gauge("x")                      # kind collision
+    reg.counter("y", labels={"a": "1"})
+    with pytest.raises(ValueError, match="one name, one schema"):
+        reg.counter("y")                    # label-KEY-set collision
+    # distinct label VALUES are distinct series under the same schema
+    other = reg.counter("y", labels={"a": "2"})
+    assert other is not reg.counter("y", labels={"a": "1"})
+
+
+def test_prometheus_exposition():
+    reg = tm.MetricsRegistry()
+    reg.counter("steps_total").inc(7)
+    reg.gauge("loss", labels={"split": "eval"}).set(0.25)
+    h = reg.histogram("step_time_ms")
+    h.observe(2.0, n=9)
+    h.observe(8.0)
+    text = reg.prometheus_text()
+    assert "# TYPE steps_total counter" in text
+    assert "steps_total 7" in text
+    assert 'loss{split="eval"} 0.25' in text
+    assert "# TYPE step_time_ms summary" in text
+    assert 'step_time_ms{quantile="0.5"} 2' in text
+    assert "step_time_ms_count 10" in text
+
+
+def test_append_jsonl_schema_versioned(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    out = tm.append_jsonl(path, {"epoch": 1})
+    assert out["schema"] == 2 and out["epoch"] == 1
+    rec = json.loads(open(path).read())
+    assert rec["schema"] == 2 and "time" in rec
+    # an explicit schema in the record wins over the default
+    out2 = tm.append_jsonl(path, {"schema": 3, "epoch": 2})
+    assert out2["schema"] == 3
+
+
+# -- StepTimer ------------------------------------------------------------
+
+def test_step_timer_compile_vs_steady_split_on_jitted_step():
+    @jax.jit
+    def step(x):
+        return x @ x
+
+    x = jnp.ones((64, 64))
+    st = tm.StepTimer()
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step(x))
+        st.record_window(time.perf_counter() - t0, steps=1, examples=64)
+    # first fenced window (trace+compile+first step) is split out
+    assert st.compile_time_s is not None and st.compile_time_s > 0
+    assert st.steps == 4
+    p50_s = st.quantile_ms(0.5) / 1e3
+    assert st.compile_time_s > p50_s        # compiling dwarfs a 64x64 matmul
+    assert st.examples_per_sec > 0
+    s = st.summary()
+    assert s["steps"] == 4
+    assert s["step_time_ms_p95"] >= s["step_time_ms_p50"] > 0
+    assert s["step_time_ms_max"] >= s["step_time_ms_p95"]
+    assert s["tokens_per_sec"] is None      # none were reported
+
+
+def test_step_timer_windowed_weighting():
+    st = tm.StepTimer()
+    st.record_window(10.0, steps=1)                      # compile
+    st.record_window(1.0, steps=10, examples=100)        # 100ms/step x10
+    st.record_window(0.2, steps=1, examples=10)          # one 200ms step
+    assert st.steps == 11
+    assert st.quantile_ms(0.5) == 100.0
+    assert st.summary()["step_time_ms_max"] == 200.0
+    assert st.examples_per_sec == pytest.approx(110 / 1.2)
+
+
+def test_compiled_cost_stats_best_effort():
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    sds = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    stats = tm.compiled_cost_stats(f, sds)
+    # the backend may or may not expose a cost model; the contract is
+    # "dict with positive flops, or None" and never an exception
+    assert stats is None or stats["flops"] > 0
+
+
+# -- tracer ---------------------------------------------------------------
+
+def test_chrome_trace_well_formed(tmp_path):
+    tr = tm.Tracer()
+    with tr.span("outer", epoch=1):
+        with tr.span("inner"):
+            time.sleep(0.002)
+    tr.instant("marker")
+    path = tr.write(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = {e["name"]: e for e in doc["traceEvents"]}
+    assert {"process_name", "outer", "inner", "marker"} <= set(events)
+    for name in ("outer", "inner"):
+        ev = events[name]
+        assert ev["ph"] == "X" and ev["dur"] >= 0
+        assert {"ts", "pid", "tid"} <= set(ev)
+    inner, outer = events["inner"], events["outer"]
+    assert inner["ts"] >= outer["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert events["outer"]["args"] == {"epoch": 1}
+    assert events["marker"]["ph"] == "i"
+
+
+def test_span_closes_on_exception():
+    tr = tm.Tracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("failing"):
+            raise RuntimeError("boom")
+    names = [e["name"] for e in tr.to_chrome_trace()["traceEvents"]]
+    assert "failing" in names               # the failing interval is kept
+
+
+# -- bubble model ---------------------------------------------------------
+
+def test_bubble_fraction_schedule_model():
+    assert tm.schedule_bubble_fraction(1, 1) == 0.0
+    assert tm.schedule_bubble_fraction(1, 8, "1f1b") == 0.0
+    assert tm.schedule_bubble_fraction(4, 8) == pytest.approx(3 / 11)
+    # more microbatches -> smaller bubble, monotonically
+    fr = [tm.schedule_bubble_fraction(4, m) for m in (1, 2, 4, 8, 16)]
+    assert fr == sorted(fr, reverse=True)
+    # non-interleaved 1F1B never exceeds GPipe (equality: same fill/drain)
+    for s in (2, 3, 4, 8):
+        for m in (1, 2, 4, 8):
+            assert (tm.schedule_bubble_fraction(s, m, "1f1b")
+                    <= tm.schedule_bubble_fraction(s, m, "gpipe"))
+    with pytest.raises(ValueError, match="unknown schedule"):
+        tm.schedule_bubble_fraction(2, 2, "interleaved")
+
+
+def test_ideal_step_time_anchors_measured():
+    # S=2, M=1: bubble 0.5 -> ideal is half the measured step
+    assert tm.ideal_step_time(1.0, 2, 1) == pytest.approx(0.5)
+    # single stage: already bubble-free
+    assert tm.ideal_step_time(1.0, 1, 4) == pytest.approx(1.0)
+
+
+# -- static ICI gauge -----------------------------------------------------
+
+def test_expected_ici_bytes_ranks_pipeline_hops():
+    from simple_distributed_machine_learning_tpu.analysis import abstractify
+    from simple_distributed_machine_learning_tpu.train.optimizer import sgd
+    from simple_distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+    )
+
+    stages, wire_dim, out_dim = make_mlp_stages(jax.random.key(0),
+                                                [784, 32, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wire_dim, out_dim, n_microbatches=2)
+    step = make_train_step(pipe, sgd(0.1, 0.5))
+    buf = pipe.init_params()
+    opt_state = sgd(0.1, 0.5).init(buf)
+    x = jnp.zeros((60, 784))
+    y = jnp.zeros((60,), jnp.int32)
+    info = tm.expected_ici_bytes(
+        step, abstractify(buf), abstractify(opt_state), abstractify(x),
+        abstractify(y), abstractify(jax.random.key(0)), None, mesh=mesh)
+    assert info is not None
+    assert info["ici_bytes_per_step"] > 0
+    prims = {c["prim"] for c in info["collectives"]}
+    assert "ppermute" in prims              # the stage-hop ring dominates
+    # registry mirroring
+    reg = tm.MetricsRegistry()
+    from simple_distributed_machine_learning_tpu.telemetry import ici
+    ici.record(reg, info)
+    assert reg.snapshot()["ici_bytes_per_step"] == info["ici_bytes_per_step"]
+
+
+def test_expected_ici_bytes_never_raises():
+    def broken(x):
+        raise TypeError("untraceable")
+
+    assert tm.expected_ici_bytes(
+        broken, jax.ShapeDtypeStruct((2,), jnp.float32)) is None
+
+
+# -- hardened profiler.trace ----------------------------------------------
+
+def test_profiler_trace_disabled_and_bad_logdir(tmp_path, capsys):
+    from simple_distributed_machine_learning_tpu.utils.profiler import trace
+
+    with trace(enabled=False) as d:
+        assert d is None                    # nothing created, nothing started
+    blocker = tmp_path / "a_file"
+    blocker.write_text("not a dir")
+    with trace(str(blocker / "sub")) as d:  # makedirs must fail
+        assert d is None                    # degraded to disabled, no raise
+
+
+def test_profiler_trace_no_leak_on_body_exception(tmp_path):
+    from simple_distributed_machine_learning_tpu.utils.profiler import trace
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with trace(str(tmp_path / "t1")):
+            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+            raise RuntimeError("boom")
+    # the first trace was stopped despite the exception: a fresh one starts
+    with trace(str(tmp_path / "t2")) as d:
+        assert d == str(tmp_path / "t2")
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    found = [f for _, _, fs in os.walk(tmp_path / "t2") for f in fs]
+    assert found, "second trace produced no files: first one leaked"
+
+
+# -- Trainer smoke with a full session ------------------------------------
+
+def _toy_trainer(tmp_path, tele, epochs=2, n_train=240):
+    train, test = synthetic_mnist(n_train=n_train, n_test=60, seed=7)
+    train = Dataset(train.x.reshape(len(train.x), -1), train.y)
+    test = Dataset(test.x.reshape(len(test.x), -1), test.y)
+    stages, wire_dim, out_dim = make_mlp_stages(jax.random.key(0),
+                                                [784, 32, 10], 2)
+    pipe = Pipeline(stages, make_mesh(n_stages=2, n_data=1), wire_dim,
+                    out_dim, n_microbatches=2)
+    cfg = TrainConfig(epochs=epochs, batch_size=60, print_throughput=False,
+                      metrics_json=str(tmp_path / "metrics_v2.jsonl"))
+    return Trainer(pipe, train, test, cfg, telemetry=tele)
+
+
+def test_trainer_smoke_emits_full_epoch_records(tmp_path):
+    tele = tm.Telemetry(str(tmp_path / "tele"))
+    _toy_trainer(tmp_path, tele).fit()
+
+    lines = open(tmp_path / "tele" / tm.METRICS_FILE).read().splitlines()
+    recs = [json.loads(ln) for ln in lines]
+    assert [r["epoch"] for r in recs] == [1, 2]
+    for r in recs:
+        assert r["schema"] == 2
+        # throughput AND memory fields on every record (the smoke contract)
+        assert r["examples_per_sec"] > 0
+        assert r["live_array_bytes"] > 0
+        assert r["step_time_ms_p50"] > 0
+        assert r["step_time_ms_p95"] >= r["step_time_ms_p50"]
+        assert r["bubble_fraction"] == pytest.approx(1 / 3, abs=1e-4)  # S=2, M=2
+        assert r["ici_bytes_per_step"] > 0
+        # the training record rides along: documented keys intact
+        assert {"train_loss", "eval_loss", "accuracy"} <= set(r)
+    # compile split: only the run's FIRST step is a compile window, so
+    # epoch 1 has batches-1 steady steps and epoch 2 adds all 4 of its own
+    assert recs[0]["steps"] == 3 and recs[1]["steps"] == 7
+    assert recs[0]["compile_time_s"] > 0
+
+    trace = json.load(open(tmp_path / "tele" / tm.TRACE_FILE))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"feed", "step", "eval", "epoch_end"} <= names
+    prom = open(tmp_path / "tele" / tm.PROM_FILE).read()
+    assert "# TYPE step_time_ms summary" in prom
+    assert "epochs_total 2" in prom
+
+    # the --metrics-json stream stays intact AND schema-versioned
+    v2 = [json.loads(ln)
+          for ln in open(tmp_path / "metrics_v2.jsonl").read().splitlines()]
+    assert all(r["schema"] == 2 and "accuracy" in r for r in v2)
+
+
+def test_telemetry_every_n_fences_sparsely(tmp_path):
+    tele = tm.Telemetry(str(tmp_path / "tele"), every=3)
+    tr = _toy_trainer(tmp_path, tele, epochs=1, n_train=360)  # 6 batches
+    tr.fit()
+    [rec] = [json.loads(ln) for ln in
+             open(tmp_path / "tele" / tm.METRICS_FILE).read().splitlines()]
+    # batch 0 force-fenced (compile); steps 2..6 fence at seen%3==0 -> the
+    # (2,3) window and the (4,5,6) window: 5 steady steps in 2 windows
+    assert rec["steps"] == 5
+    assert rec["compile_time_s"] > 0
+    assert rec["step_time_ms_p50"] > 0
+
+
+def test_telemetry_every_validates():
+    with pytest.raises(ValueError, match="every"):
+        tm.Telemetry("/tmp/unused_tele", every=0)
+
+
+def test_trainer_without_telemetry_unchanged(tmp_path, capsys):
+    """telemetry=None is the reference path: no files, same console."""
+    _toy_trainer(tmp_path, None, epochs=1).fit()
+    out = capsys.readouterr().out
+    assert "Test set: Average loss:" in out
+    assert not (tmp_path / "tele").exists()

@@ -115,8 +115,12 @@ def test_metrics_json_records_per_epoch(tmp_path):
     records = [json.loads(l) for l in path.read_text().splitlines()]
     assert [r["epoch"] for r in records] == [1, 2, 3]
     for r in records:
-        assert set(r) == {"epoch", "step", "train_loss", "samples_per_sec",
-                          "eval_loss", "accuracy", "correct", "n_eval"}
+        assert set(r) == {"schema", "time", "epoch", "step", "train_loss",
+                          "samples_per_sec", "eval_loss", "accuracy",
+                          "correct", "n_eval"}
+        # versioned since the telemetry registry took over the write path;
+        # every pre-existing documented key is still present above
+        assert r["schema"] == 2
         assert r["n_eval"] == 60
         assert 0 <= r["correct"] <= 60
         # accuracy is the documented headline key; the raw counts it is
