@@ -438,7 +438,7 @@ def measure(name: str, spec: dict, windows: int = 5,
 
 
 def measure_decode(windows: int = 5, cfg=None, prompt_len: int = 32,
-                   b: int = 8) -> dict:
+                   b: int = 8, extra_batches: tuple = (1, 32)) -> dict:
     """Decode throughput: KV-cache vs full-prefix-recompute decoders.
 
     Default shape: the MXU-sized GPT (d=512, L=4, V=8192) generating 224
@@ -477,12 +477,15 @@ def measure_decode(windows: int = 5, cfg=None, prompt_len: int = 32,
     stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
     params = [s.params for s in stages]
     n_disp = 1 + windows * 4            # warm + (1+3) dispatches per window
-    prompts = jax.random.randint(jax.random.key(1), (n_disp, b, t0), 0,
-                                 cfg.vocab)
-    key = jax.random.key(2)
-    jax.block_until_ready(prompts)
 
-    def timed(fn):
+    def prompt_pool(bb):
+        return jax.block_until_ready(jax.random.randint(
+            jax.random.key(1), (n_disp, bb, t0), 0, cfg.vocab))
+
+    prompts = prompt_pool(b)
+    key = jax.random.key(2)
+
+    def timed(fn, prompts=prompts):
         it = iter(range(n_disp))
 
         def one():
@@ -520,6 +523,16 @@ def measure_decode(windows: int = 5, cfg=None, prompt_len: int = 32,
         "device_kind": jax.devices()[0].device_kind,
         "backend": jax.default_backend(),
     }
+    # batched-decode columns (additive): the cached decoder at other batch
+    # sizes — the per-batch-size baseline the serving sweep (--serve) is
+    # judged against (a continuous batch of K slots should approach the
+    # B=K one-shot column, and beat the B=1 sequential one)
+    for bb in extra_batches:
+        if bb == b:
+            continue
+        bs = timed(make_cached_decoder(stages, cfg, t0, n_new),
+                   prompts=prompt_pool(bb))
+        row[f"tokens_per_sec_cached_b{bb}"] = round(bb * n_new / bs, 1)
     if default_shape:
         # only the benchmark shape owns the artifact — CPU smoke-drives on
         # tiny cfgs must not clobber it
@@ -527,6 +540,86 @@ def measure_decode(windows: int = 5, cfg=None, prompt_len: int = 32,
                   "w") as f:
             json.dump(row, f, indent=2)
     return row
+
+
+def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
+                    slots: int = 8, max_new: int = 24, cfg=None,
+                    prompt_lens: tuple = (8, 16, 32)) -> list[dict]:
+    """Offered-load sweep of the continuous-batching engine (serve/).
+
+    One row per Poisson arrival rate through an ``slots``-slot engine, plus
+    the ``gpt_serve_sequential`` baseline: the SAME workload at the top
+    rate through a 1-slot engine — literal one-request-at-a-time decoding,
+    which continuous batching must beat on aggregate tokens/sec (that gap
+    is the whole subsystem's reason to exist; asserted in
+    tests/test_serve.py on the CPU smoke shape). Each row reports
+    throughput, TTFT/TPOT p50/p95 and mean slot occupancy — TTFT includes
+    genuine queue wait once the offered load exceeds slot capacity.
+
+    Engines are warmed (every prefill bucket + the decode tick compiled)
+    before the trace runs, so latency columns measure serving, not XLA
+    compilation. ``cfg``/shape params exist so CPU smoke-drives can run the
+    identical harness on a tiny model; only the default (MXU-sized) shape
+    writes the ``benchmarks/serving.json`` artifact.
+    """
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.serve import (
+        InferenceEngine,
+        ServeMetrics,
+        SimConfig,
+        simulate,
+    )
+
+    default_shape = (cfg is None and slots == 8 and n_requests == 24
+                     and max_new == 24 and rates == (2.0, 8.0, 32.0)
+                     and prompt_lens == (8, 16, 32))
+    cfg = cfg or GPTConfig(vocab=8192, seq_len=256, d_model=512, n_heads=8,
+                           n_layers=4)
+    if max(prompt_lens) + max_new > cfg.seq_len:
+        raise ValueError(
+            f"prompt {max(prompt_lens)} + max_new {max_new} exceeds "
+            f"seq_len {cfg.seq_len}")
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
+
+    def run(rate, n_slots, label):
+        engine = InferenceEngine(stages, cfg, n_slots=n_slots)
+        # warm every compiled shape OUTSIDE the measured trace: one tiny
+        # request per prompt-length bucket (prefill shapes) + decode ticks
+        for t0 in prompt_lens:
+            engine.submit(np.zeros(t0, np.int32), max_new_tokens=2)
+        engine.drain()
+        engine.metrics = metrics = ServeMetrics()
+        rep = simulate(engine, SimConfig(
+            n_requests=n_requests, rate=rate, seed=0,
+            prompt_lens=prompt_lens, max_new_tokens=max_new))
+        s = metrics.summary()
+        return {
+            "config": label, "rate": rate, "n_slots": n_slots,
+            "n_requests": n_requests, "max_new_tokens": max_new,
+            "completed": rep["completed"], "wall_s": rep["wall_s"],
+            "tokens_per_sec": s["tokens_per_sec"],
+            "ttft_ms_p50": s["ttft_ms_p50"], "ttft_ms_p95": s["ttft_ms_p95"],
+            "tpot_ms_p50": s["tpot_ms_p50"], "tpot_ms_p95": s["tpot_ms_p95"],
+            "slot_occupancy_mean": s["slot_occupancy_mean"],
+            "device_kind": jax.devices()[0].device_kind,
+            "backend": jax.default_backend(),
+        }
+
+    rows = [run(max(rates), 1, "gpt_serve_sequential")]
+    rows += [run(r, slots, "gpt_serve") for r in rates]
+    if default_shape:
+        with open(os.path.join(REPO, "benchmarks", "serving.json"),
+                  "w") as f:
+            json.dump({"device": rows[0]["device_kind"],
+                       "backend": rows[0]["backend"], "rows": rows},
+                      f, indent=2)
+    return rows
 
 
 def _measure_jax_cpu_baseline() -> float:
@@ -609,6 +702,11 @@ def main() -> None:
     ap.add_argument("--decode", action="store_true",
                     help="measure KV-cache vs recompute decode tokens/sec "
                          "(also runs as part of --all)")
+    ap.add_argument("--serve", action="store_true",
+                    help="offered-load serving sweep (serve/): continuous-"
+                         "batching tokens/sec + TTFT/TPOT p50/p95 per "
+                         "Poisson arrival rate, vs the 1-slot sequential "
+                         "baseline; writes benchmarks/serving.json")
     ap.add_argument("--opt", choices=("sgd", "adamw"), default=None,
                     help="override the per-config optimizer (experiment "
                          "rows only; results_all.json is not rewritten "
@@ -699,7 +797,7 @@ def main() -> None:
     elif args.config is not None:
         names = [args.config]
     else:
-        names = [] if args.decode else ["mlp2"]
+        names = [] if (args.decode or args.serve) else ["mlp2"]
     _smoke_check()
 
     def _run_decode() -> None:
@@ -721,6 +819,22 @@ def main() -> None:
 
     if args.decode and not args.all:
         _run_decode()
+    if args.serve:
+        for srow in measure_serving():
+            print(json.dumps({
+                "metric": f"{srow['config']}_tokens_per_sec",
+                "value": srow["tokens_per_sec"],
+                "unit": "tokens/sec",
+                "rate": srow["rate"],
+                "n_slots": srow["n_slots"],
+                "ttft_ms_p50": srow["ttft_ms_p50"],
+                "ttft_ms_p95": srow["ttft_ms_p95"],
+                "tpot_ms_p50": srow["tpot_ms_p50"],
+                "tpot_ms_p95": srow["tpot_ms_p95"],
+                "slot_occupancy_mean": srow["slot_occupancy_mean"],
+            }))
+        if not names:
+            return
     rows = []
 
     def _write_results(partial: bool) -> None:

@@ -149,6 +149,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "from the trained model (KV-cache, straight from "
                         "the live param buffer) and print them on rank 0 — "
                         "with --text-corpus, decoded bytes as text")
+    g.add_argument('--serve-sim', type=int, default=0, metavar="N",
+                   help="for --model=gpt: skip training and serve N "
+                        "simulated requests through the continuous-batching "
+                        "inference engine (serve/): seeded Poisson arrivals, "
+                        "FCFS admission into a slot-based KV-cache pool, "
+                        "EOS/budget retirement freeing slots mid-flight; "
+                        "params restore from --checkpoint-dir when a "
+                        "checkpoint exists, else fresh init; TTFT/TPOT and "
+                        "occupancy metrics land in --telemetry-dir")
+    g.add_argument('--serve-rate', type=float, default=8.0, metavar="R",
+                   help="with --serve-sim: mean request arrival rate "
+                        "(req/s) of the open-loop Poisson trace")
+    g.add_argument('--serve-slots', type=int, default=4, metavar="S",
+                   help="with --serve-sim: KV-cache pool slots (the "
+                        "continuous batch's max occupancy)")
+    g.add_argument('--serve-max-new', type=int, default=16, metavar="T",
+                   help="with --serve-sim: tokens generated per request "
+                        "(EOS may retire a request earlier)")
     g.add_argument('--text-corpus', default=None, metavar="PATH",
                    help="for --model=gpt: train on the BYTES of this local "
                         "file (vocab=256, next-byte LM, contiguous "
@@ -301,6 +319,17 @@ def _dispatch(args) -> None:
         raise SystemExit("--ep needs --model=gpt with --experts > 0")
     if args.generate > 0 and args.model != "gpt":
         raise SystemExit("--generate is only supported with --model=gpt")
+    if args.serve_sim > 0:
+        if args.model != "gpt":
+            raise SystemExit("--serve-sim is only supported with "
+                             "--model=gpt")
+        if args.experts > 0 or args.sp > 1 or args.tp > 1 or args.ep > 1:
+            raise SystemExit(
+                "--serve-sim serves a dense single-device build (the "
+                "make_cached_decoder restrictions): drop "
+                "--experts/--sp/--tp/--ep")
+        _run_serve(args, n_stages, key)
+        return
     if args.model == "gpt":
         _run_gpt(args, n_stages, key)
         return
@@ -522,6 +551,88 @@ def _run_gpt(args, n_stages: int, key) -> None:
         _print_sample(args, trainer, cfg, test_ds)
 
 
+def _run_serve(args, n_stages: int, key) -> None:
+    """--serve-sim N: continuous-batching inference over a simulated
+    open-loop Poisson trace (serve/). Params come from --checkpoint-dir
+    when a checkpoint exists (the same build the training run wrote),
+    otherwise fresh init; no training happens. Exits nonzero if any
+    request fails to complete."""
+    import os
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.serve import (
+        InferenceEngine,
+        ServeMetrics,
+        SimConfig,
+        simulate,
+    )
+
+    if args.serve_slots < 1:
+        raise SystemExit(f"--serve-slots must be >= 1, got "
+                         f"{args.serve_slots}")
+    if args.serve_max_new < 1:
+        raise SystemExit(f"--serve-max-new must be >= 1, got "
+                         f"{args.serve_max_new}")
+    cfg = GPTConfig(vocab=256 if args.text_corpus else 128)
+    stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
+    params = None
+    ckpt = (os.path.join(args.checkpoint_dir, "state.npz")
+            if args.checkpoint_dir else None)
+    if ckpt and os.path.exists(ckpt):
+        # restore the TRAINED params: same build (model flags + --stages +
+        # --seed) the training run used, unpacked from the packed buffer
+        from simple_distributed_machine_learning_tpu.parallel.mesh import (
+            make_mesh,
+        )
+        from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+            Pipeline,
+        )
+        from simple_distributed_machine_learning_tpu.train.checkpoint import (
+            restore_checkpoint,
+        )
+        pipe = Pipeline(stages, make_mesh(n_stages=n_stages), wire_dim,
+                        out_shape)
+        st = restore_checkpoint(ckpt, pipe=pipe)
+        params = pipe.unpack(st["params"])
+        print(f"| serve: restored params from {ckpt} "
+              f"(step {st['step']})")
+    else:
+        print("| serve: fresh-initialized params"
+              + (f" (no checkpoint at {ckpt})" if ckpt else ""))
+    metrics = ServeMetrics(outdir=args.telemetry_dir)
+    engine = InferenceEngine(stages, cfg, params=params,
+                             n_slots=args.serve_slots, metrics=metrics)
+    max_new = min(args.serve_max_new, cfg.seq_len - max(GPT_SERVE_PROMPTS))
+    if max_new < args.serve_max_new:
+        print(f"| serve: --serve-max-new {args.serve_max_new} clamped to "
+              f"{max_new} (seq_len {cfg.seq_len} minus the longest "
+              f"{max(GPT_SERVE_PROMPTS)}-token simulated prompt)")
+    sim = SimConfig(n_requests=args.serve_sim, rate=args.serve_rate,
+                    seed=args.seed, prompt_lens=GPT_SERVE_PROMPTS,
+                    max_new_tokens=max_new)
+    report = simulate(engine, sim)
+    s = metrics.summary()
+    print(f"| serve: {report['completed']}/{report['n_requests']} requests "
+          f"completed, {s['tokens_generated']} tokens, "
+          f"{s['tokens_per_sec']} tok/s, "
+          f"ttft p50/p95 {s['ttft_ms_p50']}/{s['ttft_ms_p95']} ms, "
+          f"tpot p50/p95 {s['tpot_ms_p50']}/{s['tpot_ms_p95']} ms, "
+          f"occupancy {s['slot_occupancy_mean']}")
+    if args.telemetry_dir:
+        metrics.emit(extra={"rate": sim.rate, "n_slots": args.serve_slots,
+                            "completed": report["completed"]})
+    if not report["all_completed"]:
+        raise SystemExit(1)
+
+
+# prompt-length buckets of the simulated serving workload (each bucket is
+# one compiled prefill shape)
+GPT_SERVE_PROMPTS = (4, 8, 12)
+
+
 def _print_sample(args, trainer, cfg, test_ds) -> None:
     """--generate N: decode N tokens from the trained model (KV-cache path,
     straight from the live packed buffer) and print them on rank 0 — for a
@@ -532,9 +643,6 @@ def _print_sample(args, trainer, cfg, test_ds) -> None:
 
     from simple_distributed_machine_learning_tpu.models.gpt import (
         decoder_from_pipeline,
-    )
-    from simple_distributed_machine_learning_tpu.train.checkpoint import (
-        _to_host,
     )
 
     n_new = min(args.generate, cfg.seq_len - 1)
@@ -568,7 +676,7 @@ def _print_sample(args, trainer, cfg, test_ds) -> None:
         prompt = np.asarray(test_ds.x[:1, :t0], np.int32)
         dec = decoder_from_pipeline(pipe, cfg, t0, n_new,
                                     cache_dtype=_compute_dtype(args))
-    toks = _to_host(dec(trainer.buf, prompt, jax.random.key(args.seed)))[0]
+    toks = _decode_timed(args, trainer, dec, prompt, n_new)[0]
     if args.text_corpus:
         text = bytes(int(t) for t in toks).decode("latin-1")
         trainer._print(f"| sample ({t0}-byte prompt + {n_new} generated):\n"
@@ -576,6 +684,50 @@ def _print_sample(args, trainer, cfg, test_ds) -> None:
     else:
         trainer._print(f"| sample tokens (prompt {t0} + {n_new} generated): "
                        f"{toks.tolist()}")
+
+
+def _decode_timed(args, trainer, dec, prompt, n_new):
+    """Run the --generate decode; with --telemetry-dir attached, route its
+    timing through the telemetry StepTimer/registry so decode latency and
+    tokens/sec land in metrics.jsonl (+ the Prometheus exposition) instead
+    of being print-only. The first call is the compile window (StepTimer
+    splits it out); a second, different-key decode measures the steady
+    latency — distinct inputs so a result-cached re-dispatch cannot fake
+    the number (bench.py's measure_decode discipline)."""
+    import time as _time
+
+    import jax
+
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        _to_host,
+    )
+
+    tele = trainer.telemetry
+    key = jax.random.key(args.seed)
+    if tele is None:
+        return _to_host(dec(trainer.buf, prompt, key))
+    from simple_distributed_machine_learning_tpu.telemetry.registry import (
+        append_jsonl,
+    )
+    from simple_distributed_machine_learning_tpu.telemetry.timer import (
+        StepTimer,
+    )
+    timer = StepTimer(registry=tele.registry, name="decode_time_ms")
+    b, n_tok = prompt.shape[0], prompt.shape[0] * n_new
+    t0 = _time.perf_counter()
+    toks = _to_host(dec(trainer.buf, prompt, key))
+    timer.record_window(_time.perf_counter() - t0, steps=1)   # compile window
+    t0 = _time.perf_counter()
+    jax.block_until_ready(dec(trainer.buf, prompt,
+                              jax.random.fold_in(key, 1)))
+    timer.record_window(_time.perf_counter() - t0, steps=1, tokens=n_tok)
+    if trainer.is_main:
+        import os
+        rec = {"kind": "decode", "batch": int(b), "n_new": int(n_new),
+               **timer.summary()}
+        append_jsonl(os.path.join(tele.outdir, "metrics.jsonl"), rec)
+        tele.flush()                     # decode series -> metrics.prom
+    return toks
 
 
 if __name__ == "__main__":

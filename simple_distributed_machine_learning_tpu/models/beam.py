@@ -13,6 +13,14 @@ length normalization — all beams have the same fixed length here, so
 normalization would not change the argmax). ``beam_size=1`` is exactly
 greedy decoding (pinned in tests/test_beam.py).
 
+EOS termination (``eos_id``): a beam that emits ``eos_id`` is *finished* —
+its score freezes at the log-prob of its sequence up to and including EOS,
+and its only continuation is EOS itself at log-prob 0, so it rides the
+remaining (static-length) scan as an eos-padded row competing on its frozen
+score. The returned tokens are therefore eos-padded after the first EOS and
+the score is the finished prefix's, the standard fixed-shape beam-EOS
+treatment.
+
 The reference has no inference path at all
 (``/root/reference/simple_distributed.py:119-132`` is eval-only); greedy /
 sampled (top-k/top-p) / beam decoding are capability extensions completing
@@ -40,16 +48,21 @@ from simple_distributed_machine_learning_tpu.ops.layers import (
 
 
 def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
-                      beam_size: int = 4, cache_dtype=None):
+                      beam_size: int = 4, cache_dtype=None,
+                      eos_id: int | None = None):
     """Build the jitted beam decoder. Single-device dense builds only (the
     :func:`~.gpt.make_cached_decoder` restrictions; ``cache_dtype`` as there
-    — bf16 halves the K*B beam-cache memory)."""
+    — bf16 halves the K*B beam-cache memory). ``eos_id``: beams finishing on
+    this token freeze their score and eos-pad (module docstring)."""
     if cfg.n_seq > 1:
         raise ValueError(
             "beam decode is single-device; rebuild the stages with n_seq=1")
     if not 1 <= beam_size <= cfg.vocab:
         raise ValueError(
             f"beam_size={beam_size} out of range [1, vocab={cfg.vocab}]")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(
+            f"eos_id={eos_id} outside [0, vocab={cfg.vocab})")
     total = _validate_decode_build(stages, cfg, prompt_len, n_new,
                                    "make_beam_decoder")
     K = beam_size
@@ -83,9 +96,11 @@ def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
         toks = toks.at[:, :, 0].set(t0)
         kc = jnp.repeat(kc, K, axis=1)                      # [L, B*K, ...]
         vc = jnp.repeat(vc, K, axis=1)
+        done = (t0 == eos_id) if eos_id is not None else jnp.zeros((b, K),
+                                                                   bool)
 
         def step(carry, i):
-            kc, vc, toks, scores = carry
+            kc, vc, toks, scores, done = carry
             # last chosen token of every beam enters at position i-? — the
             # token written at step j sits at buffer col j and global
             # position prompt_len + j; at loop index i we consume col i-1
@@ -99,6 +114,11 @@ def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
                 h, kc, vc = _dense_block_step(bp, h, li, kc, vc, pos_i,
                                               total, H)
             row = _head_logprobs(head, h[:, 0]).reshape(b, K, V)
+            if eos_id is not None:
+                # finished beams: only continuation is EOS at log-prob 0 —
+                # the beam rides the rest of the scan on its frozen score
+                pad = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+                row = jnp.where(done[:, :, None], pad[None, None, :], row)
             cand = scores[:, :, None] + row                 # [B, K, V]
             scores, flat = lax.top_k(cand.reshape(b, K * V), K)
             beam_idx = flat // V                            # [B, K]
@@ -114,11 +134,14 @@ def make_beam_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
             toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
             toks = lax.dynamic_update_index_in_dim(
                 toks, new_tok, i, 2)
-            return (kc, vc, toks, scores), None
+            if eos_id is not None:
+                done = (jnp.take_along_axis(done, beam_idx, axis=1)
+                        | (new_tok == eos_id))
+            return (kc, vc, toks, scores, done), None
 
         if n_new > 1:
-            (kc, vc, toks, scores), _ = lax.scan(
-                step, (kc, vc, toks, scores), 1 + jnp.arange(n_new - 1))
+            (kc, vc, toks, scores, done), _ = lax.scan(
+                step, (kc, vc, toks, scores, done), 1 + jnp.arange(n_new - 1))
         best = jnp.argmax(scores, axis=1)                   # [B]
         best_toks = jnp.take_along_axis(
             toks, best[:, None, None], axis=1)[:, 0]        # [B, n_new]

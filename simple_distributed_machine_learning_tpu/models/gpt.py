@@ -19,6 +19,7 @@ averages NLL over batch and sequence.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 
 import jax
@@ -660,6 +661,16 @@ def _validate_decode_build(stages, cfg, prompt_len, n_new, caller):
         raise ValueError(
             f"prompt {prompt_len} + n_new {n_new} exceeds the model's "
             f"sequence length {cfg.seq_len}")
+    _check_embed_matches(stages, cfg)
+    return total
+
+
+def _check_embed_matches(stages, cfg: GPTConfig) -> None:
+    """The one copy of the cfg-vs-build shape check every decoder-style
+    builder runs (cached/beam via :func:`_validate_decode_build`, the
+    serving slot ops via :func:`_validate_slot_build`): a mismatched cfg
+    would otherwise silently clamp pos-table slices past the real seq_len
+    instead of raising."""
     embed = next((s.params.get("embed") for s in stages
                   if isinstance(s.params, dict) and "embed" in s.params),
                  None)
@@ -669,7 +680,6 @@ def _validate_decode_build(stages, cfg, prompt_len, n_new, caller):
             f"cfg (seq_len={cfg.seq_len}, d_model={cfg.d_model}) does not "
             f"match the stages' embedding table {got} — pass the GPTConfig "
             f"the stages were built with")
-    return total
 
 
 def _merged_stage_trees(params_list):
@@ -711,6 +721,49 @@ def _sample_row(row, k, temperature, top_k, top_p):
         k, ks = jax.random.split(k)
         return _sample_from(row, ks, temperature, top_k, top_p), k
     return jnp.argmax(row, axis=-1), k
+
+
+def _filter_top_dyn(scaled: jax.Array, top_k: jax.Array,
+                    top_p: jax.Array) -> jax.Array:
+    """Traced-argument counterpart of :func:`_filter_top` on ONE row [V] —
+    the serving engine's decode tick samples every slot in a single compiled
+    program, so each request's top-k/top-p knobs arrive as device scalars.
+    ``top_k == 0`` disables top-k; ``top_p > 1`` disables top-p. When a
+    filter IS enabled the math mirrors the static version step for step
+    (same k-th-largest threshold, same exclusive-cumsum rule, top-k before
+    top-p with the second sort on the top-k-filtered row), so a served
+    request's filtered distribution matches its solo decode bit for bit."""
+    V = scaled.shape[-1]
+    srt = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)        # descending
+    kth = jnp.take(srt, jnp.clip(top_k, 1, V) - 1, axis=-1)
+    scaled = jnp.where((top_k >= 1) & (scaled < kth), -jnp.inf, scaled)
+    srt = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)        # post-top-k
+    p = jax.nn.softmax(srt, axis=-1)
+    exclusive = jnp.cumsum(p, axis=-1) - p
+    keep = exclusive < top_p                                  # top-1 always
+    thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)
+    return jnp.where((top_p <= 1.0) & (scaled < thresh), -jnp.inf, scaled)
+
+
+def _sample_dyn(row: jax.Array, key_data: jax.Array, temperature: jax.Array,
+                top_k: jax.Array, top_p: jax.Array
+                ) -> tuple[jax.Array, jax.Array]:
+    """One decode step on ONE row [V] with TRACED sampling params ->
+    ``(token, next_key_data)``. Mirrors :func:`_sample_row`'s key-split
+    discipline exactly — greedy (``temperature == 0``) consumes no
+    randomness, sampling splits once per token — so a served request's key
+    stream (and therefore its tokens) match its solo decode bit for bit.
+    Keys travel as raw uint32 key data so per-slot selection can use
+    ``jnp.where`` (typed key arrays reject it); ``vmap`` over slots is the
+    loop semantics, so per-slot draws equal the unbatched calls."""
+    k = jax.random.wrap_key_data(key_data)
+    nk, ks = jax.random.split(k)
+    safe_t = jnp.where(temperature > 0, temperature, jnp.float32(1.0))
+    filtered = _filter_top_dyn(row / safe_t, top_k, top_p)
+    samp = jax.random.categorical(ks, filtered, axis=-1)
+    tok = jnp.where(temperature > 0, samp, jnp.argmax(row, axis=-1))
+    kd = jnp.where(temperature > 0, jax.random.key_data(nk), key_data)
+    return tok.astype(jnp.int32), kd
 
 
 def _check_sampling_args(temperature, top_k, top_p, vocab=None):
@@ -862,6 +915,139 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
         return out
 
     return decode
+
+
+def _validate_slot_build(stages, cfg: GPTConfig, max_len: int,
+                         caller: str) -> None:
+    """Shared validation for the serving slot ops: single-device dense-MLP
+    builds only (the :func:`make_cached_decoder` restrictions — MoE routing
+    capacity is a full-sequence quantity; sharded stage trees are per-shard
+    slices, not the whole model), and ``max_len`` within the position
+    table."""
+    if cfg.n_experts > 0:
+        raise ValueError(
+            f"{caller} supports dense-MLP blocks only — MoE capacity is a "
+            f"full-sequence quantity (make_cached_decoder's restriction)")
+    if cfg.n_seq > 1:
+        raise ValueError(
+            f"{caller} is single-device; rebuild the stages with n_seq=1")
+    if any(getattr(s, "shards", None) is not None
+           or getattr(s, "expert_shards", None) is not None for s in stages):
+        raise ValueError(
+            f"{caller} needs unsharded stage params — gather tensor/expert "
+            f"shards into a dense build first")
+    if not 2 <= max_len <= cfg.seq_len:
+        raise ValueError(
+            f"slot max_len={max_len} outside [2, seq_len={cfg.seq_len}] "
+            f"(the position table bounds every slot's sequence budget)")
+    _check_embed_matches(stages, cfg)
+
+
+def make_slot_prefill(stages, cfg: GPTConfig, max_len: int,
+                      cache_dtype=None):
+    """Serving prefill-into-slot: ``prefill(params, kc, vc, prompt [1, T0],
+    slot, key_data, temperature, top_k, top_p) -> (kc, vc, token,
+    key_data)``.
+
+    Runs ONE request's prompt through every block (batch 1, exactly the
+    solo decoder's prefill shapes and math — shared :func:`_dense_qkv` /
+    ``causal_attention_core`` / :func:`_dense_attn_tail`), writes each
+    layer's K/V rows into pool row ``slot`` at positions ``[0, T0)``, and
+    samples the first output token with the request's own params and key
+    stream (:func:`_sample_dyn`'s sentinels: ``top_k=0`` / ``top_p=2.0``
+    disable). Retraces per distinct prompt length (the prompt shape is
+    static — real serving buckets prompt lengths the same way); the decode
+    tick stays one program regardless.
+
+    ``kc``/``vc``: the pool buffers, ``[L, n_slots, H, max_len, dh]`` in
+    the :func:`_cache_dtype` storage dtype (bf16 halves pool memory). They
+    are DONATED — the engine always threads the returned buffers back into
+    the pool, and donation lets XLA update the slot row in place instead of
+    copying the whole pool per call.
+    """
+    _validate_slot_build(stages, cfg, max_len, "make_slot_prefill")
+    H = cfg.n_heads
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def prefill(params, kc, vc, prompt, slot, key_data, temperature,
+                top_k, top_p):
+        embed, blocks, head = _merged_stage_trees(params)
+        t0 = prompt.shape[1]
+        ids = prompt.astype(jnp.int32)
+        h = embedding_lookup(embed["tok"], ids) + embed["pos"][:t0]
+        for li, bp in enumerate(blocks):
+            q, k_, v = _dense_qkv(bp, h, H)               # [1, H, T0, dh]
+            kc = jax.lax.dynamic_update_slice(
+                kc, k_.astype(kc.dtype)[None], (li, slot, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype)[None], (li, slot, 0, 0, 0))
+            h = _dense_attn_tail(bp, h, causal_attention_core(q, k_, v))
+        row = _head_logprobs(head, h[:, -1])[0]           # [V]
+        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
+        return kc, vc, tok, kd
+
+    return prefill
+
+
+def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads):
+    """One block on one token per SLOT (``h``: [S, 1, d]) against pool
+    cache row ``li``; each slot writes its new K/V at its OWN position
+    (``pos``: [S]) and attends ``[0, pos]``. Per-slot math is exactly
+    :func:`_dense_block_step`'s (same scale expression, same einsums, same
+    masked-row softmax), and every slot's output depends only on its own
+    cache row — the bit-exactness anchor continuous batching rests on."""
+    dh = h.shape[-1] // n_heads
+    q, knew, vnew = _dense_qkv(bp, h, n_heads)            # [S, H, 1, dh]
+
+    def upd(cache, new, p):
+        return jax.lax.dynamic_update_slice(cache, new, (0, p, 0))
+
+    kci = jax.vmap(upd)(kc[li], knew.astype(kc.dtype), pos)
+    vci = jax.vmap(upd)(vc[li], vnew.astype(vc.dtype), pos)
+    kc = kc.at[li].set(kci)
+    vc = vc.at[li].set(vci)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kci) / math.sqrt(dh)
+    live = (jnp.arange(kci.shape[-2])[None, None, None, :]
+            <= pos[:, None, None, None])
+    scores = jnp.where(live, scores, -jnp.inf)
+    a = jnp.einsum("bhqk,bhkd->bhqd",
+                   jax.nn.softmax(scores, axis=-1), vci)
+    return _dense_attn_tail(bp, h, a), kc, vc
+
+
+def make_slot_decode_step(stages, cfg: GPTConfig, max_len: int,
+                          cache_dtype=None):
+    """Serving decode tick: ``step(params, kc, vc, toks [S], pos [S],
+    key_data [S, 2], temps [S], top_ks [S], top_ps [S]) -> (kc, vc,
+    next_toks [S], next_key_data [S, 2])``.
+
+    ONE batched token step over ALL ``n_slots`` slots — static shapes, so a
+    single compiled program serves every tick regardless of occupancy.
+    Each slot consumes its carried token at its own position, lands its K/V
+    row via a per-slot scatter, attends its masked cache row, and samples
+    with its own params and key stream (``vmap`` of :func:`_sample_dyn` —
+    loop semantics, per-slot draws equal the unbatched calls). Inactive
+    slots compute garbage that the engine discards host-side; their stale
+    cache writes are invisible by construction (see ``serve/slots.py``).
+    ``kc``/``vc`` are donated (same contract as :func:`make_slot_prefill`):
+    one in-place pool update per tick, not a pool-sized copy per token.
+    """
+    _validate_slot_build(stages, cfg, max_len, "make_slot_decode_step")
+    H = cfg.n_heads
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+        embed, blocks, head = _merged_stage_trees(params)
+        pe = jnp.take(embed["pos"], pos, axis=0)[:, None]     # [S, 1, d]
+        h = embedding_lookup(embed["tok"], toks[:, None]) + pe
+        for li, bp in enumerate(blocks):
+            h, kc, vc = _dense_block_step_slots(bp, h, li, kc, vc, pos, H)
+        rows = _head_logprobs(head, h[:, 0])                  # [S, V]
+        toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
+                                           top_ks, top_ps)
+        return kc, vc, toks2, kd2
+
+    return step
 
 
 def decoder_from_pipeline(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,

@@ -1,0 +1,53 @@
+"""Continuous-batching inference serving (the north star's traffic layer).
+
+Every decoder below ``serve/`` (``models/gpt.py`` cached, ``models/beam.py``,
+``models/pp_decode.py``) is one-shot: one prompt batch in, all tokens out.
+Production TPU serving is dominated by *continuous batching* — admitting and
+retiring sequences mid-flight inside one compiled step — and by TTFT/TPOT
+latency accounting (PAPERS.md: "Fine-Tuning and Serving Gemma on Cloud TPU").
+This package is that layer, on top of the existing KV-cache model ops,
+checkpoint restore, and the telemetry registry:
+
+- :mod:`.slots` — slot-based KV-cache pool: ``n_slots`` static-shape rows of
+  per-layer K/V buffers with per-slot position counters and occupancy
+  accounting (the invariant-guarded free list);
+- :mod:`.request` — the request object: prompt, per-request sampling params
+  (greedy / top-k / top-p with an independent seeded key stream),
+  ``max_new_tokens`` / EOS termination, and latency timestamps;
+- :mod:`.scheduler` — FCFS continuous-batching scheduler: admits from the
+  queue into free slots, retires on EOS or token budget, freeing slots
+  immediately so waiting requests board mid-flight;
+- :mod:`.engine` — :class:`InferenceEngine`: ``submit() -> handle``,
+  ``step()`` (one admit+decode tick — ONE compiled program per tick
+  regardless of occupancy), ``drain()``, streaming per-token callbacks;
+- :mod:`.simulator` — open-loop traffic simulator: seeded Poisson arrivals
+  at a configurable rate driving the engine (``cli.py --serve-sim``);
+- :mod:`.metrics` — serving telemetry on the PR-4 ``MetricsRegistry``:
+  queue-depth / slot-occupancy gauges, TTFT and per-output-token latency
+  histograms, aggregate tokens/sec — JSONL + Prometheus.
+
+Correctness anchor (tests/test_serve.py): with the same seed, every
+request's tokens are bit-exact vs decoding it alone through
+``models.make_cached_decoder`` — continuous batching is a scheduling
+optimization, not a math change.
+"""
+
+from simple_distributed_machine_learning_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine,
+)
+from simple_distributed_machine_learning_tpu.serve.metrics import (  # noqa: F401
+    ServeMetrics,
+)
+from simple_distributed_machine_learning_tpu.serve.request import (  # noqa: F401
+    Request,
+)
+from simple_distributed_machine_learning_tpu.serve.scheduler import (  # noqa: F401
+    FCFSScheduler,
+)
+from simple_distributed_machine_learning_tpu.serve.simulator import (  # noqa: F401
+    SimConfig,
+    simulate,
+)
+from simple_distributed_machine_learning_tpu.serve.slots import (  # noqa: F401
+    KVCachePool,
+)

@@ -1,0 +1,235 @@
+"""The continuous-batching inference engine: submit / step / drain.
+
+:class:`InferenceEngine` is the serving API over the slot-wise model ops
+(``models/gpt.py::make_slot_prefill`` / ``make_slot_decode_step``), the
+KV-cache pool and the FCFS scheduler:
+
+- ``submit(prompt, ...) -> Request`` enqueues one sequence with its own
+  sampling params and seeded key stream, and returns the live handle
+  (``handle.tokens`` grows as the engine runs; ``on_token`` streams);
+- ``step()`` is one *tick*: admit waiting requests into free slots (one
+  prefill each — compiled per prompt length), then ONE batched decode step
+  over all slots (one compiled program regardless of occupancy), then
+  retire finished requests so their slots free for the next tick;
+- ``drain()`` ticks until queue and slots are empty.
+
+Device state is exactly the pool's K/V buffers; everything else (positions,
+last tokens, key streams, request lifecycle) is host-side numpy assembled
+into each tick's inputs — the scheduler stays plain Python while every FLOP
+runs inside the two jitted programs.
+
+Correctness anchor: a request's tokens are bit-exact vs decoding it alone
+via ``make_cached_decoder`` with the same seed (tests/test_serve.py) —
+admission order, co-residents, and occupancy cannot change anyone's output.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from simple_distributed_machine_learning_tpu.serve.metrics import ServeMetrics
+from simple_distributed_machine_learning_tpu.serve.request import (
+    ACTIVE,
+    DONE,
+    Request,
+    validate_request,
+)
+from simple_distributed_machine_learning_tpu.serve.scheduler import (
+    FCFSScheduler,
+)
+from simple_distributed_machine_learning_tpu.serve.slots import KVCachePool
+
+# sampling-param sentinels (models/gpt.py::_sample_dyn): 0 disables top-k,
+# anything > 1 disables top-p
+_NO_TOP_K = 0
+_NO_TOP_P = 2.0
+
+
+class InferenceEngine:
+    """Continuous-batching serving over a dense single-device GPT build.
+
+    ``stages``/``cfg``: a ``make_gpt_stages`` build (dense-MLP, unsharded —
+    the ``make_cached_decoder`` restrictions). ``params`` overrides the
+    stages' init weights (e.g. checkpoint-restored trees from
+    ``Pipeline.unpack``). ``max_len`` caps each slot's prompt+generation
+    budget (defaults to ``cfg.seq_len``); ``cache_dtype`` is the pool's
+    storage dtype (bf16 halves pool memory, the ``_cache_dtype`` rule).
+    """
+
+    def __init__(self, stages, cfg, *, params=None, n_slots: int = 4,
+                 max_len: int | None = None, cache_dtype=None,
+                 metrics: ServeMetrics | None = None,
+                 scheduler: FCFSScheduler | None = None,
+                 clock=time.monotonic) -> None:
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            make_slot_decode_step,
+            make_slot_prefill,
+        )
+        self.cfg = cfg
+        self.params = (params if params is not None
+                       else [s.params for s in stages])
+        self.max_len = int(max_len if max_len is not None else cfg.seq_len)
+        n_layers = sum(len(p["blocks"]) for p in self.params)
+        self.pool = KVCachePool(n_layers, n_slots, cfg.n_heads, self.max_len,
+                                cfg.d_model // cfg.n_heads, cache_dtype)
+        self._prefill = make_slot_prefill(stages, cfg, self.max_len,
+                                          cache_dtype)
+        self._decode = make_slot_decode_step(stages, cfg, self.max_len,
+                                             cache_dtype)
+        self.scheduler = scheduler or FCFSScheduler(self.pool)
+        self.metrics = metrics
+        self._clock = clock
+        self._next_rid = 0
+        self.requests: dict[int, Request] = {}
+        # per-request last-emit timestamps for TPOT accounting
+        self._last_emit: dict[int, float] = {}
+
+    # -- public API --------------------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.scheduler.queue_depth or self.pool.n_active)
+
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               top_k: int | None = None, top_p: float | None = None,
+               eos_id: int | None = None, seed: int | None = None,
+               on_token=None, arrival_time: float | None = None) -> Request:
+        """Enqueue one request; returns its live handle immediately.
+
+        ``arrival_time`` backdates ``submit_time`` to when the request
+        actually ARRIVED (the open-loop simulator's Poisson timestamp), so
+        TTFT absorbs queue wait accrued while the engine was inside a tick
+        — without it, arrival-to-submit wait would silently vanish from
+        the headline latency exactly in the overload regime."""
+        import jax
+
+        prompt = np.asarray(prompt, np.int32)
+        validate_request(prompt, max_new_tokens, temperature, top_k, top_p,
+                         self.cfg.vocab, self.max_len)
+        rid = self._next_rid
+        self._next_rid += 1
+        seed = rid if seed is None else seed
+        r = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    eos_id=eos_id, seed=seed, on_token=on_token)
+        # the request's independent key stream — the SAME key a solo
+        # make_cached_decoder call would be handed, so streams align
+        r.key_data = np.asarray(jax.random.key_data(jax.random.key(seed)))
+        r.submit_time = (self._clock() if arrival_time is None
+                         else arrival_time)
+        self.requests[rid] = r
+        self.scheduler.enqueue(r)
+        if self.metrics is not None:
+            self.metrics.on_submit()
+        return r
+
+    def step(self) -> int:
+        """One tick (admit -> batched decode -> retire); returns the number
+        of tokens emitted. A true no-op returning 0 when idle — idle ticks
+        touch no metrics, so a polling loop cannot drag the occupancy
+        histogram toward zero."""
+        if not self.busy:
+            return 0
+        emitted = self._admit()
+        # occupancy the batched decode actually RUNS at — sampled before
+        # same-tick retirement so short requests cannot bias it low
+        decode_active = self.pool.n_active
+        emitted += self._decode_tick()
+        if self.metrics is not None:
+            self.metrics.on_tick(self.scheduler.queue_depth,
+                                 self.pool.n_active, self.pool.n_slots,
+                                 decode_active=decode_active)
+        return emitted
+
+    def drain(self, max_ticks: int | None = None) -> list[Request]:
+        """Tick until idle (or ``max_ticks``); returns finished requests in
+        completion order is not guaranteed — use ``handle.tokens``."""
+        ticks = 0
+        while self.busy:
+            if max_ticks is not None and ticks >= max_ticks:
+                raise RuntimeError(
+                    f"drain exceeded {max_ticks} ticks with "
+                    f"{self.scheduler.queue_depth} queued / "
+                    f"{self.pool.n_active} active — a request is stuck")
+            self.step()
+            ticks += 1
+        return [r for r in self.requests.values() if r.state == DONE]
+
+    # -- tick internals ----------------------------------------------------
+
+    def _admit(self) -> int:
+        emitted = 0
+        for r in self.scheduler.admit():
+            t0 = int(r.prompt.shape[0])
+            kc, vc, tok, kd = self._prefill(
+                self.params, self.pool.kc, self.pool.vc,
+                r.prompt[None, :], np.int32(r.slot), r.key_data,
+                np.float32(r.temperature),
+                np.int32(r.top_k if r.top_k is not None else _NO_TOP_K),
+                np.float32(r.top_p if r.top_p is not None else _NO_TOP_P))
+            self.pool.kc, self.pool.vc = kc, vc
+            tok = int(np.asarray(tok))           # host sync: TTFT endpoint
+            r.key_data = np.asarray(kd)
+            now = self._clock()
+            r.first_token_time = now
+            self._last_emit[r.rid] = now
+            r.emit(tok)
+            emitted += 1
+            if self.metrics is not None:
+                self.metrics.on_first_token(r.ttft_s)
+            reason = r.finished_by(tok)
+            if reason is not None:
+                self._finish(r, reason, now)
+            else:
+                self.pool.seat(r.slot, t0, tok)
+        return emitted
+
+    def _decode_tick(self) -> int:
+        active = self.pool.active_slots()
+        if not active:
+            return 0
+        S = self.pool.n_slots
+        kd = np.zeros((S, 2), np.uint32)
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.full(S, _NO_TOP_P, np.float32)
+        for s in active:
+            r = self.requests[self.pool.occupant(s)]
+            kd[s] = r.key_data
+            temps[s] = r.temperature
+            top_ks[s] = r.top_k if r.top_k is not None else _NO_TOP_K
+            top_ps[s] = r.top_p if r.top_p is not None else _NO_TOP_P
+        kc, vc, toks, kd2 = self._decode(
+            self.params, self.pool.kc, self.pool.vc,
+            self.pool.last_token.copy(), self.pool.positions.copy(),
+            kd, temps, top_ks, top_ps)
+        self.pool.kc, self.pool.vc = kc, vc
+        toks = np.asarray(toks)                  # host sync: tick endpoint
+        kd2 = np.asarray(kd2)
+        now = self._clock()
+        emitted = 0
+        for s in active:
+            r = self.requests[self.pool.occupant(s)]
+            tok = int(toks[s])
+            r.key_data = kd2[s]
+            r.emit(tok)
+            emitted += 1
+            if self.metrics is not None:
+                self.metrics.on_token(now - self._last_emit[r.rid])
+            self._last_emit[r.rid] = now
+            reason = r.finished_by(tok)
+            if reason is not None:
+                self._finish(r, reason, now)
+            else:
+                self.pool.advance(s, tok)
+        return emitted
+
+    def _finish(self, r: Request, reason: str, now: float) -> None:
+        r.done_time = now
+        self._last_emit.pop(r.rid, None)
+        if r.state == ACTIVE:
+            self.scheduler.retire(r, reason)
+        if self.metrics is not None:
+            self.metrics.on_complete()

@@ -1,0 +1,133 @@
+"""Serving telemetry on the PR-4 ``MetricsRegistry``: JSONL + Prometheus.
+
+The serving-standard latency split, as registry instruments:
+
+- ``serve_ttft_ms`` (histogram) — time to first token: queue wait + prefill,
+  per request. The latency a user perceives before anything streams.
+- ``serve_tpot_ms`` (histogram) — time per output token after the first:
+  the decode-tick cadence, one observation per generated token.
+- ``serve_queue_depth`` / ``serve_slots_active`` (gauges) and
+  ``serve_slot_occupancy`` (histogram of active/total per tick) — how full
+  the continuous batch runs; occupancy is what batched decoding converts
+  into aggregate throughput.
+- ``serve_requests_submitted_total`` / ``serve_requests_completed_total`` /
+  ``serve_tokens_generated_total`` (counters) and ``serve_tokens_per_sec``
+  (gauge over the wall-clock window from first submit to last token).
+
+``emit()`` writes one ``kind: "serve"`` record to ``metrics.jsonl`` and
+refreshes ``metrics.prom`` — the same two artifact formats the training
+telemetry session emits, so one scrape config covers both.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from simple_distributed_machine_learning_tpu.telemetry.registry import (
+    MetricsRegistry,
+    append_jsonl,
+)
+
+METRICS_FILE = "metrics.jsonl"
+PROM_FILE = "metrics.prom"
+
+
+class ServeMetrics:
+    """One serving run's instruments; see module docstring."""
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 outdir: str | None = None,
+                 clock=time.monotonic) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.outdir = outdir
+        self._clock = clock
+        self._t_first_submit: float | None = None
+        self._t_last_token: float | None = None
+        r = self.registry
+        self.queue_depth = r.gauge("serve_queue_depth")
+        self.slots_active = r.gauge("serve_slots_active")
+        self.slots_total = r.gauge("serve_slots_total")
+        self.occupancy = r.histogram("serve_slot_occupancy")
+        self.ttft_ms = r.histogram("serve_ttft_ms")
+        self.tpot_ms = r.histogram("serve_tpot_ms")
+        self.submitted = r.counter("serve_requests_submitted_total")
+        self.completed = r.counter("serve_requests_completed_total")
+        self.tokens = r.counter("serve_tokens_generated_total")
+        self.tokens_per_sec = r.gauge("serve_tokens_per_sec")
+        if outdir:
+            os.makedirs(outdir, exist_ok=True)
+
+    # -- event hooks (engine-driven) --------------------------------------
+
+    def on_submit(self) -> None:
+        if self._t_first_submit is None:
+            self._t_first_submit = self._clock()
+        self.submitted.inc()
+
+    def on_first_token(self, ttft_s: float) -> None:
+        self.ttft_ms.observe(ttft_s * 1e3)
+        self._on_any_token()
+
+    def on_token(self, tpot_s: float) -> None:
+        self.tpot_ms.observe(tpot_s * 1e3)
+        self._on_any_token()
+
+    def _on_any_token(self) -> None:
+        self.tokens.inc()
+        self._t_last_token = self._clock()
+        span = self.window_s
+        if span and span > 0:
+            self.tokens_per_sec.set(self.tokens.value / span)
+
+    def on_complete(self) -> None:
+        self.completed.inc()
+
+    def on_tick(self, queue_depth: int, active: int, total: int,
+                decode_active: int | None = None) -> None:
+        """End-of-tick gauges; ``decode_active`` is the occupancy the tick's
+        batched decode ran at (sampled BEFORE same-tick retirement — the
+        number batching converts into throughput). Ticks that ran no decode
+        (``decode_active == 0``) skip the occupancy observation."""
+        self.queue_depth.set(queue_depth)
+        self.slots_active.set(active)
+        self.slots_total.set(total)
+        occ = active if decode_active is None else decode_active
+        if occ and total:
+            self.occupancy.observe(occ / total)
+
+    # -- aggregation -------------------------------------------------------
+
+    @property
+    def window_s(self) -> float | None:
+        """First submit -> last token wall-clock span (the throughput
+        denominator; None before any token)."""
+        if self._t_first_submit is None or self._t_last_token is None:
+            return None
+        return self._t_last_token - self._t_first_submit
+
+    def summary(self) -> dict:
+        """The serving record block (bench rows and ``emit`` embed it)."""
+        r3 = (lambda v: None if v is None else round(v, 3))
+        return {
+            "requests_submitted": int(self.submitted.value),
+            "requests_completed": int(self.completed.value),
+            "tokens_generated": int(self.tokens.value),
+            "tokens_per_sec": round(self.tokens_per_sec.value, 1),
+            "ttft_ms_p50": r3(self.ttft_ms.quantile(0.5)),
+            "ttft_ms_p95": r3(self.ttft_ms.quantile(0.95)),
+            "tpot_ms_p50": r3(self.tpot_ms.quantile(0.5)),
+            "tpot_ms_p95": r3(self.tpot_ms.quantile(0.95)),
+            "slot_occupancy_mean": r3(self.occupancy.mean),
+        }
+
+    def emit(self, extra: dict | None = None) -> dict | None:
+        """Append one ``kind: "serve"`` JSONL record and rewrite the
+        Prometheus exposition into ``outdir`` (no-op without one)."""
+        if not self.outdir:
+            return None
+        rec = {"kind": "serve", **self.summary(), **(extra or {})}
+        rec = append_jsonl(os.path.join(self.outdir, METRICS_FILE), rec)
+        with open(os.path.join(self.outdir, PROM_FILE), "w") as f:
+            f.write(self.registry.prometheus_text())
+        return rec

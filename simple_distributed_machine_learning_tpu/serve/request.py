@@ -1,0 +1,106 @@
+"""The serving request object: prompt, sampling params, lifecycle timestamps.
+
+One :class:`Request` is one user sequence moving through the engine:
+``QUEUED`` (waiting for a slot) → ``ACTIVE`` (owns a KV-cache slot, decoding)
+→ ``DONE`` (EOS emitted or ``max_new_tokens`` reached; slot freed). Sampling
+config is per-request — greedy (``temperature=0``) or temperature sampling
+with optional top-k / top-p filtering — with an independent key stream seeded
+from ``seed``, so two requests never share randomness and each one's tokens
+are bit-exact vs decoding it alone (tests/test_serve.py).
+
+Latency accounting follows the serving-standard split: TTFT (time to first
+token — queue wait + prefill) and TPOT (time per output token — the decode
+tick cadence), both recorded by the engine on host wall-clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+QUEUED = "queued"
+ACTIVE = "active"
+DONE = "done"
+
+
+@dataclasses.dataclass
+class Request:
+    """One sequence's serving state; constructed via ``engine.submit``."""
+
+    rid: int
+    prompt: np.ndarray                  # [T0] int32 tokens
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    eos_id: int | None = None
+    seed: int = 0
+    # streaming: called with (request, token:int) as each token materializes
+    on_token: Callable | None = None
+
+    # -- lifecycle (engine-owned) -----------------------------------------
+    state: str = QUEUED
+    slot: int | None = None
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    key_data: np.ndarray | None = None  # live PRNG key data (uint32 [2])
+    submit_time: float | None = None
+    first_token_time: float | None = None
+    done_time: float | None = None
+    finish_reason: str | None = None    # "eos" | "length"
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.submit_time is None or self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+    @property
+    def tpot_s(self) -> float | None:
+        """Mean time per output token AFTER the first (None for 1-token
+        requests — there is no inter-token interval to average)."""
+        if (self.first_token_time is None or self.done_time is None
+                or len(self.tokens) < 2):
+            return None
+        return (self.done_time - self.first_token_time) / (len(self.tokens) - 1)
+
+    def emit(self, token: int) -> None:
+        self.tokens.append(int(token))
+        if self.on_token is not None:
+            self.on_token(self, int(token))
+
+    def finished_by(self, token: int) -> str | None:
+        """Finish reason if ``token`` (just emitted) terminates the request."""
+        if self.eos_id is not None and int(token) == self.eos_id:
+            return "eos"
+        if len(self.tokens) >= self.max_new_tokens:
+            return "length"
+        return None
+
+
+def validate_request(prompt: np.ndarray, max_new_tokens: int,
+                     temperature: float, top_k: int | None,
+                     top_p: float | None, vocab: int, max_len: int) -> None:
+    """Submit-time validation: length/prompt bounds here, sampling args
+    delegated to the one-shot decoders' ``_check_sampling_args`` — one
+    source of truth, so a request the engine accepts is exactly one
+    ``make_cached_decoder`` accepts."""
+    prompt = np.asarray(prompt)
+    if prompt.ndim != 1 or prompt.shape[0] < 1:
+        raise ValueError(
+            f"prompt must be a non-empty 1-D token array, got shape "
+            f"{prompt.shape}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if prompt.shape[0] + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt {prompt.shape[0]} + max_new_tokens {max_new_tokens} "
+            f"exceeds the pool's sequence budget {max_len}")
+    if prompt.min() < 0 or prompt.max() >= vocab:
+        raise ValueError(
+            f"prompt tokens outside [0, vocab={vocab})")
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        _check_sampling_args,
+    )
+    _check_sampling_args(temperature, top_k, top_p, vocab)

@@ -1,0 +1,77 @@
+"""FCFS continuous-batching scheduler: queue -> slots, EOS/budget -> free.
+
+The policy layer between the request queue and the KV-cache pool. FCFS
+(first-come-first-served) admission is the serving baseline — no reordering,
+no preemption — which keeps TTFT fairness trivial to reason about and makes
+the scheduler invariants sharp enough to pin in tests:
+
+- a request is admitted the first tick a slot is free, never before a
+  request that arrived earlier (queue order IS arrival order);
+- retirement (EOS sampled, or ``max_new_tokens`` reached) releases the slot
+  in the SAME tick, so a waiting request boards on the very next tick —
+  that mid-flight boarding is the whole point of continuous batching;
+- the pool's own guards make double-occupancy and double-release raise
+  rather than corrupt (``serve/slots.py``).
+
+Smarter policies (shortest-job-first on ``max_new_tokens``, priority
+classes) would subclass and override :meth:`FCFSScheduler.pick`.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from simple_distributed_machine_learning_tpu.serve.request import (
+    ACTIVE,
+    DONE,
+    QUEUED,
+    Request,
+)
+from simple_distributed_machine_learning_tpu.serve.slots import KVCachePool
+
+
+class FCFSScheduler:
+    """First-come-first-served admission over a :class:`KVCachePool`."""
+
+    def __init__(self, pool: KVCachePool) -> None:
+        self.pool = pool
+        self.queue: collections.deque[Request] = collections.deque()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def enqueue(self, request: Request) -> None:
+        if request.state != QUEUED:
+            raise ValueError(
+                f"request {request.rid} is {request.state}, not queued")
+        self.queue.append(request)
+
+    def pick(self) -> Request:
+        """The next request to admit (FCFS: the oldest). Override for other
+        policies; callers guarantee the queue is non-empty."""
+        return self.queue.popleft()
+
+    def admit(self) -> list[Request]:
+        """Board waiting requests into free slots (as many as fit), FCFS.
+        Returns the newly admitted requests with ``slot`` assigned; the
+        engine prefills each one."""
+        admitted = []
+        while self.queue and self.pool.n_free:
+            r = self.pick()
+            r.slot = self.pool.acquire(r.rid)
+            r.state = ACTIVE
+            admitted.append(r)
+        return admitted
+
+    def retire(self, request: Request, reason: str) -> None:
+        """Free the request's slot immediately (same tick) so the next
+        :meth:`admit` can reuse it."""
+        if request.state != ACTIVE or request.slot is None:
+            raise ValueError(
+                f"request {request.rid} is not active (state "
+                f"{request.state!r}, slot {request.slot!r})")
+        self.pool.release(request.slot)
+        request.slot = None
+        request.state = DONE
+        request.finish_reason = reason

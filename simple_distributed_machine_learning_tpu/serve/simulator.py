@@ -1,0 +1,145 @@
+"""Open-loop traffic simulator: seeded Poisson arrivals driving the engine.
+
+*Open-loop* means arrivals do not wait for the system — requests arrive on
+their own clock (exponential inter-arrival gaps at ``rate`` req/s, seeded,
+so a run is reproducible) whether or not slots are free. That is the load
+shape that actually stresses a serving stack: above slot capacity the queue
+grows and TTFT absorbs the wait, which is exactly what the offered-load
+sweep in ``bench.py --serve`` charts.
+
+The simulated workload is a seeded mix of prompt lengths and per-request
+sampling configs (greedy and temperature/top-k). Because continuous batching
+is a scheduling optimization and not a math change, each request's tokens
+are a pure function of its own (prompt, sampling params, seed) — so the
+simulator's outputs are deterministic even though wall-clock timing decides
+the admission interleave (pinned in tests/test_serve.py).
+
+``cli.py --serve-sim N`` is the command-line surface; ``simulate`` is the
+library entry bench rows call directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from simple_distributed_machine_learning_tpu.serve.engine import (
+    InferenceEngine,
+)
+from simple_distributed_machine_learning_tpu.serve.request import DONE
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """One traffic run: ``n_requests`` Poisson arrivals at ``rate`` req/s."""
+
+    n_requests: int = 16
+    rate: float = 8.0
+    seed: int = 0
+    # workload mix: prompt lengths cycle through these buckets (each bucket
+    # is one compiled prefill shape), max_new_tokens per request
+    prompt_lens: tuple = (4, 8, 12)
+    max_new_tokens: int = 16
+    # sampling mix: this fraction of requests sample at `temperature` with
+    # `top_k` (rest decode greedy); every request gets an independent seed
+    sampled_fraction: float = 0.5
+    temperature: float = 0.8
+    top_k: int | None = 8
+    eos_id: int | None = None
+
+    def __post_init__(self):
+        if self.n_requests < 1:
+            raise ValueError(f"n_requests must be >= 1, got "
+                             f"{self.n_requests}")
+        if self.rate <= 0:
+            raise ValueError(f"rate must be > 0 req/s, got {self.rate}")
+        if not self.prompt_lens:
+            raise ValueError("prompt_lens must be non-empty")
+
+    @classmethod
+    def from_duration(cls, rate: float, duration_s: float, **kw
+                      ) -> "SimConfig":
+        """Duration form of the open-loop trace: ``rate`` req/s sustained
+        for ``duration_s`` seconds (expected arrivals, at least one)."""
+        if duration_s <= 0:
+            raise ValueError(f"duration_s must be > 0, got {duration_s}")
+        return cls(n_requests=max(1, round(rate * duration_s)), rate=rate,
+                   **kw)
+
+
+def build_workload(sim: SimConfig, vocab: int) -> tuple[np.ndarray, list]:
+    """Seeded ``(arrival_times [N], request_specs)``: the whole run's
+    traffic, reproducible from ``sim.seed`` alone. Specs are ``submit``
+    kwargs; request ``i``'s sampling seed is derived from ``(sim.seed, i)``
+    so two runs of the same config produce the same per-request tokens."""
+    rng = np.random.default_rng(sim.seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / sim.rate, sim.n_requests))
+    specs = []
+    for i in range(sim.n_requests):
+        t0 = int(sim.prompt_lens[i % len(sim.prompt_lens)])
+        prompt = rng.integers(0, vocab, t0).astype(np.int32)
+        sampled = rng.random() < sim.sampled_fraction
+        specs.append(dict(
+            prompt=prompt,
+            max_new_tokens=sim.max_new_tokens,
+            temperature=sim.temperature if sampled else 0.0,
+            top_k=sim.top_k if sampled else None,
+            eos_id=sim.eos_id,
+            seed=sim.seed * 100003 + i,
+        ))
+    return arrivals, specs
+
+
+def simulate(engine: InferenceEngine, sim: SimConfig,
+             clock=None, sleep=time.sleep) -> dict:
+    """Run the open-loop trace through ``engine``; returns the report dict
+    (pure JSON-serializable — the live request handles stay reachable via
+    ``engine.requests``, keyed by rid in submit order).
+
+    ``clock`` defaults to the ENGINE's clock so arrival timestamps (which
+    become ``submit_time`` for TTFT) and the engine's first-token stamps
+    share one origin; override only with a clock the engine was also
+    constructed with.
+
+    The loop: submit every request whose arrival time has passed, tick the
+    engine while anything is in flight, sleep (briefly) only when idle
+    before the next arrival. Latency metrics are real wall-clock — TTFT
+    includes genuine queue wait when offered load exceeds slot capacity.
+    """
+    clock = engine._clock if clock is None else clock
+    arrivals, specs = build_workload(sim, engine.cfg.vocab)
+    handles = []
+    start = clock()
+    i = 0
+    while i < sim.n_requests or engine.busy:
+        t = clock() - start
+        while i < sim.n_requests and arrivals[i] <= t:
+            # submit_time = the ARRIVAL timestamp, not "now": wait accrued
+            # while the loop was inside a tick belongs to this TTFT
+            handles.append(engine.submit(
+                **specs[i], arrival_time=start + float(arrivals[i])))
+            i += 1
+        if engine.busy:
+            engine.step()
+        elif i < sim.n_requests:
+            sleep(min(max(arrivals[i] - (clock() - start), 0.0), 0.05))
+    wall_s = clock() - start
+    completed = sum(1 for h in handles if h.state == DONE)
+    report = {
+        "n_requests": sim.n_requests,
+        "rate": sim.rate,
+        "completed": completed,
+        "all_completed": completed == sim.n_requests,
+        "wall_s": round(wall_s, 3),
+        "requests": [
+            {"rid": h.rid, "prompt_len": int(h.prompt.shape[0]),
+             "n_tokens": len(h.tokens), "finish_reason": h.finish_reason,
+             "ttft_s": None if h.ttft_s is None else round(h.ttft_s, 4),
+             "tpot_s": None if h.tpot_s is None else round(h.tpot_s, 5)}
+            for h in handles],
+    }
+    if engine.metrics is not None:
+        report.update(engine.metrics.summary())
+    return report

@@ -1,0 +1,110 @@
+"""Slot-based KV-cache pool: the serving engine's one device-resident state.
+
+A *slot* is one row of every layer's K/V cache — the static-shape home of one
+in-flight sequence. The pool owns:
+
+- device buffers ``kc``/``vc`` of shape ``[L, n_slots, H, max_len, dh]``
+  (bf16-capable via the same ``cache_dtype`` rule as every one-shot decoder:
+  ``models/gpt.py::_cache_dtype``);
+- host-side per-slot position counters (the next cache index each slot
+  writes) and last-token values — tiny arrays fed into every compiled tick;
+- the free-slot list with invariant guards: acquiring an occupied slot or
+  releasing a free one raises instead of silently corrupting a neighbor's
+  cache (the scheduler invariants pinned in tests/test_serve.py).
+
+Shapes never change at runtime: admission writes INTO a slot row at its own
+offsets, retirement just returns the row to the free list — one compiled
+decode program serves every occupancy.
+
+Stale-write safety: an idle slot keeps its stale position, and the batched
+decode step keeps writing garbage K/V there while the slot is unoccupied.
+That is safe by construction — a row at cache index ``p`` only ever becomes
+visible to attention at the tick that FIRST reaches position ``p``, and that
+same tick overwrites index ``p`` with the real K/V before attending; prefill
+likewise overwrites ``[0, prompt_len)`` on admission and resets the counter.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class KVCachePool:
+    """Fixed-capacity slot pool; see module docstring."""
+
+    def __init__(self, n_layers: int, n_slots: int, n_heads: int,
+                 max_len: int, head_dim: int, cache_dtype=None) -> None:
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2 (a prompt token plus a "
+                             f"generated one), got {max_len}")
+        import jax.numpy as jnp
+
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            _cache_dtype,
+        )
+        self.n_slots = n_slots
+        self.max_len = max_len
+        shape = (n_layers, n_slots, n_heads, max_len, head_dim)
+        cd = _cache_dtype(cache_dtype)
+        self.kc = jnp.zeros(shape, cd)
+        self.vc = jnp.zeros(shape, cd)
+        # host mirrors of per-slot decode state (assembled into each tick's
+        # device inputs; the authoritative copy lives here, not on device)
+        self.positions = np.zeros(n_slots, np.int32)
+        self.last_token = np.zeros(n_slots, np.int32)
+        self._occupant: list[int | None] = [None] * n_slots
+        self._free: list[int] = list(range(n_slots))[::-1]   # pop() -> slot 0 first
+
+    # -- occupancy accounting ---------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def active_slots(self) -> list[int]:
+        return [s for s, r in enumerate(self._occupant) if r is not None]
+
+    def occupant(self, slot: int) -> int | None:
+        return self._occupant[slot]
+
+    def acquire(self, rid: int) -> int:
+        """Claim a free slot for request ``rid``; raises when full or on a
+        double-occupancy attempt (the invariant, not a best-effort)."""
+        if not self._free:
+            raise RuntimeError("KVCachePool.acquire on a full pool — the "
+                               "scheduler must check n_free first")
+        slot = self._free.pop()
+        if self._occupant[slot] is not None:     # pragma: no cover - guard
+            raise RuntimeError(
+                f"slot {slot} already occupied by request "
+                f"{self._occupant[slot]} — free-list corruption")
+        self._occupant[slot] = rid
+        return slot
+
+    def release(self, slot: int) -> None:
+        if self._occupant[slot] is None:
+            raise RuntimeError(f"release of already-free slot {slot}")
+        self._occupant[slot] = None
+        self._free.append(slot)
+
+    # -- per-slot decode state --------------------------------------------
+
+    def seat(self, slot: int, prompt_len: int, first_token: int) -> None:
+        """Post-prefill seating: the slot's next write position is
+        ``prompt_len`` (the first generated token's position) and its
+        pending input token is the freshly sampled one."""
+        if not 0 < prompt_len < self.max_len:
+            raise ValueError(f"prompt_len {prompt_len} outside (0, "
+                             f"{self.max_len})")
+        self.positions[slot] = prompt_len
+        self.last_token[slot] = int(first_token)
+
+    def advance(self, slot: int, next_token: int) -> None:
+        self.positions[slot] += 1
+        self.last_token[slot] = int(next_token)

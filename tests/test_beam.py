@@ -105,3 +105,63 @@ def test_beam_validation():
         make_beam_decoder(stages, CFG, 4, 4, beam_size=0)
     with pytest.raises(ValueError, match="exceeds the model's sequence"):
         make_beam_decoder(stages, CFG, 20, 9)
+    with pytest.raises(ValueError, match="eos_id"):
+        make_beam_decoder(stages, CFG, 4, 4, eos_id=CFG.vocab)
+    with pytest.raises(ValueError, match="eos_id"):
+        make_beam_decoder(stages, CFG, 4, 4, eos_id=-1)
+
+
+def test_beam_eos_terminates_greedy_path():
+    """beam_size=1 with eos_id: tokens match the greedy cached decode up to
+    and including the FIRST eos, then eos-pad; the score freezes at the
+    finished prefix's cumulative log-prob (verified independently)."""
+    stages, params = _model()
+    prompt = jax.random.randint(jax.random.key(4), (2, 5), 0, CFG.vocab)
+    greedy = np.asarray(make_cached_decoder(stages, CFG, 5, 8)(
+        params, prompt, jax.random.key(0)))
+    eos = int(greedy[0, 5 + 2])          # an eos greedy actually emits
+    toks, scores = make_beam_decoder(stages, CFG, 5, 8, beam_size=1,
+                                     eos_id=eos)(
+        params, prompt, jax.random.key(0))
+    toks = np.asarray(toks)
+    for b in range(2):
+        want = greedy[b, 5:]
+        hits = np.where(want == eos)[0]
+        cut = int(hits[0]) + 1 if len(hits) else 8
+        np.testing.assert_array_equal(toks[b, 5:5 + cut], want[:cut])
+        assert (toks[b, 5 + cut:] == eos).all()     # eos-padded tail
+        # frozen score == the model's own log-prob of the finished prefix
+        ref = _seq_logprob(stages, params, toks[b:b + 1, :5 + cut], 5)
+        np.testing.assert_allclose(float(scores[b]), ref, rtol=2e-4,
+                                   atol=2e-4)
+
+
+def test_beam_eos_unfinished_beams_keep_searching():
+    """An eos_id no beam emits must not change the no-eos result (the
+    finished-beam machinery is inert until an EOS actually fires)."""
+    stages, params = _model()
+    prompt = jax.random.randint(jax.random.key(5), (2, 4), 0, CFG.vocab)
+    base_t, base_s = make_beam_decoder(stages, CFG, 4, 6, beam_size=3)(
+        params, prompt, jax.random.key(0))
+    base_t = np.asarray(base_t)
+    unused = [v for v in range(CFG.vocab) if v not in base_t][0]
+    got_t, got_s = make_beam_decoder(stages, CFG, 4, 6, beam_size=3,
+                                     eos_id=unused)(
+        params, prompt, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(got_t), base_t)
+    np.testing.assert_allclose(np.asarray(got_s), np.asarray(base_s))
+
+
+def test_beam_prompt_batch_matches_individual():
+    """B>1 prompt batches are independent: batched beam decode equals each
+    prompt decoded alone (same beams, same scores)."""
+    stages, params = _model()
+    prompt = jax.random.randint(jax.random.key(6), (3, 5), 0, CFG.vocab)
+    dec = make_beam_decoder(stages, CFG, 5, 6, beam_size=3)
+    toks_b, scores_b = dec(params, prompt, jax.random.key(0))
+    for b in range(3):
+        t1, s1 = dec(params, prompt[b:b + 1], jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(toks_b)[b],
+                                      np.asarray(t1)[0])
+        np.testing.assert_allclose(float(scores_b[b]), float(s1[0]),
+                                   rtol=1e-5, atol=1e-5)

@@ -124,6 +124,36 @@ def test_cli_generate_prints_sample(tmp_path, capsys):
     assert len(ast.literal_eval(line)) == 40
 
 
+def test_cli_generate_telemetry_records_decode(tmp_path, capsys):
+    """--generate with --telemetry-dir routes decode timing through the
+    telemetry StepTimer/registry: a kind=decode record with decode latency
+    and tokens/sec lands in metrics.jsonl (and the decode series rides the
+    Prometheus exposition) instead of being print-only."""
+    import json
+    import os
+
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(b"xyzxyzxyz " * 400)
+    tele = str(tmp_path / "tele")
+    main(["--rank", "0", "--world_size", "1", "--model", "gpt",
+          "--text-corpus", str(p), "--stages", "2", "--epochs", "1",
+          "--dryrun", "2", "--batch-size", "12", "--microbatches", "2",
+          "--generate", "16", "--telemetry-dir", tele])
+    out = capsys.readouterr().out
+    assert "| sample (" in out                       # print surface intact
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(tele, "metrics.jsonl")).read().splitlines()]
+    dec = [r for r in recs if r.get("kind") == "decode"]
+    assert len(dec) == 1
+    d = dec[0]
+    assert d["schema"] == 2 and d["n_new"] == 16
+    assert d["compile_time_s"] > 0                   # first decode window
+    assert d["step_time_ms_p50"] > 0                 # steady decode window
+    assert d["tokens_per_sec"] > 0
+    assert "decode_time_ms" in open(
+        os.path.join(tele, "metrics.prom")).read()
+
+
 def test_cli_generate_requires_gpt():
     with pytest.raises(SystemExit, match="--generate is only supported"):
         main(["--rank", "0", "--model", "mlp", "--generate", "8"])

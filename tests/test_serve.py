@@ -1,0 +1,373 @@
+"""Continuous-batching serving (serve/): parity, invariants, traffic.
+
+The load-bearing property: continuous batching is a SCHEDULING optimization,
+not a math change — for a fixed seed, every request's tokens are bit-exact
+vs decoding it alone through ``models.make_cached_decoder``, across mixed
+prompt lengths, mid-flight admissions, EOS early exits, and every sampling
+mode. Plus the scheduler invariants (no double occupancy, every request
+completes, freed slots reuse next tick, queues drain above capacity), the
+serving metrics, the simulator, the checkpoint→serve path, and the
+bench sweep's continuous-beats-sequential claim.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.models.gpt import (
+    GPTConfig,
+    make_cached_decoder,
+    make_gpt_stages,
+    make_slot_decode_step,
+    make_slot_prefill,
+)
+from simple_distributed_machine_learning_tpu.serve import (
+    InferenceEngine,
+    ServeMetrics,
+    SimConfig,
+    simulate,
+)
+from simple_distributed_machine_learning_tpu.serve.request import (
+    DONE,
+    Request,
+    validate_request,
+)
+from simple_distributed_machine_learning_tpu.serve.slots import KVCachePool
+
+CFG = GPTConfig(vocab=32, seq_len=48, d_model=32, n_heads=2, n_layers=2)
+_STAGES = None
+
+
+def _model():
+    global _STAGES
+    if _STAGES is None:
+        _STAGES = make_gpt_stages(jax.random.key(0), CFG, 2)[0]
+    return _STAGES, [s.params for s in _STAGES]
+
+
+def _solo(stages, params, prompt, n_new, seed, temperature=0.0, top_k=None,
+          top_p=None):
+    """The reference tokens: this request decoded ALONE through the
+    one-shot KV-cache decoder with the same seed and sampling params."""
+    dec = make_cached_decoder(stages, CFG, len(prompt), n_new,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p)
+    out = dec(params, np.asarray(prompt, np.int32)[None],
+              jax.random.key(seed))
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _prompt(n, seed):
+    return np.asarray(
+        jax.random.randint(jax.random.key(seed), (n,), 0, CFG.vocab),
+        np.int32)
+
+
+# ---------------------------------------------------------------------------
+# parity: bit-exact vs solo decode
+
+
+def test_single_request_matches_solo_decode():
+    stages, params = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=3)
+    r = eng.submit(_prompt(5, 1), max_new_tokens=6, seed=11)
+    eng.drain()
+    assert r.state == DONE and r.finish_reason == "length"
+    np.testing.assert_array_equal(
+        r.tokens, _solo(stages, params, r.prompt, 6, 11))
+
+
+def test_mixed_prompt_lengths_and_sampling_parity():
+    """5 requests, 2 slots (so queueing + mid-flight boarding happens),
+    mixed prompt lengths and sampling modes — each request's tokens are
+    bit-exact vs its solo decode."""
+    stages, params = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=2)
+    specs = [
+        dict(prompt=_prompt(3, 2), max_new_tokens=7, seed=20),
+        dict(prompt=_prompt(9, 3), max_new_tokens=5, seed=21,
+             temperature=0.8, top_k=5),
+        dict(prompt=_prompt(5, 4), max_new_tokens=8, seed=22,
+             temperature=0.9, top_p=0.9),
+        dict(prompt=_prompt(7, 5), max_new_tokens=4, seed=23),
+        dict(prompt=_prompt(4, 6), max_new_tokens=6, seed=24,
+             temperature=1.1, top_k=7, top_p=0.8),
+    ]
+    handles = [eng.submit(**s) for s in specs]
+    eng.drain()
+    for h, s in zip(handles, specs):
+        want = _solo(stages, params, s["prompt"], s["max_new_tokens"],
+                     s["seed"], temperature=s.get("temperature", 0.0),
+                     top_k=s.get("top_k"), top_p=s.get("top_p"))
+        np.testing.assert_array_equal(np.asarray(h.tokens), want,
+                                      err_msg=f"request {h.rid}")
+
+
+def test_mid_flight_admission_parity():
+    """A request admitted while another is mid-decode gets the same tokens
+    as its solo decode — co-residents cannot change anyone's output."""
+    stages, params = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=2)
+    r1 = eng.submit(_prompt(6, 7), max_new_tokens=10, seed=30)
+    for _ in range(4):                       # r1 alone for 4 ticks
+        eng.step()
+    assert 0 < len(r1.tokens) < 10
+    r2 = eng.submit(_prompt(4, 8), max_new_tokens=6, seed=31,
+                    temperature=0.7, top_k=4)
+    eng.drain()
+    np.testing.assert_array_equal(
+        r1.tokens, _solo(stages, params, r1.prompt, 10, 30))
+    np.testing.assert_array_equal(
+        r2.tokens, _solo(stages, params, r2.prompt, 6, 31,
+                         temperature=0.7, top_k=4))
+
+
+def test_eos_early_exit_parity_and_slot_free():
+    """EOS retires the request with a PREFIX of its solo decode (up to and
+    including the first EOS) and frees the slot immediately."""
+    stages, params = _model()
+    solo = _solo(stages, params, _prompt(5, 9), 8, 40)
+    eos = int(solo[2])                       # an eos the solo decode emits
+    cut = int(np.where(solo == eos)[0][0]) + 1   # ...its FIRST occurrence
+    eng = InferenceEngine(stages, CFG, n_slots=1)
+    r = eng.submit(_prompt(5, 9), max_new_tokens=8, seed=40, eos_id=eos)
+    eng.drain()
+    assert r.finish_reason == "eos"
+    assert len(r.tokens) == cut < 8
+    np.testing.assert_array_equal(r.tokens, solo[:cut])
+    assert eng.pool.n_free == 1
+
+
+# ---------------------------------------------------------------------------
+# scheduler invariants
+
+
+def test_queue_drains_above_capacity_no_double_occupancy():
+    """9 requests through 2 slots: occupancy never exceeds capacity, a
+    slot never hosts two requests (pool guards raise), every request
+    completes, and a freed slot is reused on the next tick."""
+    stages, params = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=2)
+    handles = [eng.submit(_prompt(3 + i % 3, 10 + i),
+                          max_new_tokens=3 + i % 4, seed=50 + i)
+               for i in range(9)]
+    max_active = 0
+    while eng.busy:
+        queued_before = eng.scheduler.queue_depth
+        eng.step()
+        assert eng.pool.n_active <= 2
+        max_active = max(max_active, eng.pool.n_active)
+        # FCFS: the queue never grows mid-run (no re-queueing); slots can
+        # all retire within one decode tick, so n_active == 0 with work
+        # still queued is legal — the next tick's admission boards it
+        assert eng.scheduler.queue_depth <= queued_before
+        occ = [eng.pool.occupant(s) for s in eng.pool.active_slots()]
+        assert len(occ) == len(set(occ))     # no slot double-occupied
+    assert all(h.state == DONE for h in handles)
+    assert eng.scheduler.queue_depth == 0
+    assert max_active == 2                   # the batch actually filled
+    # each completed with its requested token budget, and parity held
+    for i, h in enumerate(handles):
+        assert len(h.tokens) == 3 + i % 4
+        np.testing.assert_array_equal(
+            h.tokens, _solo(stages, params, h.prompt, len(h.tokens),
+                            50 + i))
+
+
+def test_freed_slot_reusable_next_tick():
+    stages, _ = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=1)
+    r1 = eng.submit(_prompt(4, 30), max_new_tokens=1, seed=60)
+    r2 = eng.submit(_prompt(6, 31), max_new_tokens=5, seed=61)
+    eng.step()                    # tick 1: r1 prefills, finishes, frees
+    assert r1.state == DONE and eng.pool.n_free == 1
+    assert r2.state == "queued"
+    eng.step()                    # tick 2: r2 boards the freed slot
+    assert r2.state == "active" and r2.slot is not None
+    assert len(r2.tokens) == 2    # prefill token + one decode tick
+    eng.drain()
+    assert r2.state == DONE and len(r2.tokens) == 5
+
+
+def test_pool_guards():
+    pool = KVCachePool(2, 2, 2, 8, 4)
+    a = pool.acquire(0)
+    b = pool.acquire(1)
+    assert {a, b} == {0, 1}
+    with pytest.raises(RuntimeError, match="full pool"):
+        pool.acquire(2)
+    pool.release(a)
+    with pytest.raises(RuntimeError, match="already-free"):
+        pool.release(a)
+    assert pool.acquire(3) == a   # freed slot comes back
+
+
+def test_request_validation():
+    stages, _ = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=1, max_len=16)
+    with pytest.raises(ValueError, match="exceeds the pool"):
+        eng.submit(_prompt(10, 0), max_new_tokens=7)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.submit(np.zeros(0, np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        eng.submit(_prompt(4, 0), max_new_tokens=2, top_k=3)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit(_prompt(4, 0), max_new_tokens=2, temperature=1.0,
+                   top_k=999)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit(_prompt(4, 0), max_new_tokens=2, temperature=1.0,
+                   top_p=1.5)
+    with pytest.raises(ValueError, match="max_len"):
+        make_slot_prefill(stages, CFG, CFG.seq_len + 1)
+    with pytest.raises(ValueError, match="max_len"):
+        make_slot_decode_step(stages, CFG, 1)
+    # engine-independent request plumbing
+    validate_request(np.zeros(3, np.int32), 2, 0.0, None, None, 32, 16)
+    r = Request(rid=0, prompt=np.zeros(3, np.int32), max_new_tokens=4)
+    assert r.finished_by(7) is None
+
+
+def test_streaming_callback_order():
+    stages, params = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=1)
+    seen = []
+    r = eng.submit(_prompt(4, 12), max_new_tokens=5, seed=70,
+                   on_token=lambda req, t: seen.append((req.rid, t)))
+    eng.drain()
+    assert seen == [(r.rid, t) for t in r.tokens]
+    assert len(seen) == 5
+
+
+# ---------------------------------------------------------------------------
+# metrics + simulator
+
+
+def test_serve_metrics_populated(tmp_path):
+    stages, _ = _model()
+    metrics = ServeMetrics(outdir=str(tmp_path))
+    eng = InferenceEngine(stages, CFG, n_slots=2, metrics=metrics)
+    for i in range(3):
+        eng.submit(_prompt(4, 40 + i), max_new_tokens=4, seed=80 + i)
+    eng.drain()
+    s = metrics.summary()
+    assert s["requests_submitted"] == s["requests_completed"] == 3
+    assert s["tokens_generated"] == 12
+    assert s["ttft_ms_p50"] > 0 and s["tpot_ms_p50"] is not None
+    assert 0 < s["slot_occupancy_mean"] <= 1
+    assert metrics.ttft_ms.count == 3        # one TTFT per request
+    assert metrics.tpot_ms.count == 9        # tokens after the first
+    rec = metrics.emit(extra={"n_slots": 2})
+    assert rec["kind"] == "serve" and rec["schema"] == 2
+    got = json.loads(open(os.path.join(tmp_path, "metrics.jsonl"))
+                     .read().splitlines()[-1])
+    assert got["tokens_generated"] == 12
+    prom = open(os.path.join(tmp_path, "metrics.prom")).read()
+    assert "serve_tokens_generated_total 12" in prom
+    assert 'serve_ttft_ms{quantile="0.5"}' in prom
+
+
+def test_simulator_completes_and_is_deterministic():
+    """Open-loop Poisson trace: all requests complete, and per-request
+    tokens are identical across runs (scheduling cannot change outputs,
+    so wall-clock admission jitter is invisible in the tokens)."""
+    stages, _ = _model()
+    sim = SimConfig(n_requests=6, rate=200.0, seed=5, prompt_lens=(4, 7),
+                    max_new_tokens=5)
+
+    def run():
+        eng = InferenceEngine(stages, CFG, n_slots=2,
+                              metrics=ServeMetrics())
+        report = simulate(eng, sim)
+        json.dumps(report)           # the report is pure JSON
+        return report, [eng.requests[rid].tokens
+                        for rid in sorted(eng.requests)]
+
+    rep1, toks1 = run()
+    rep2, toks2 = run()
+    assert rep1["all_completed"] and rep2["all_completed"]
+    assert toks1 == toks2
+    assert rep1["tokens_generated"] == 6 * 5
+    assert all(r["ttft_s"] is not None for r in rep1["requests"])
+    # duration form: rate x duration expected arrivals
+    assert SimConfig.from_duration(8.0, 2.0).n_requests == 16
+    assert SimConfig.from_duration(1.0, 0.1).n_requests == 1
+    with pytest.raises(ValueError, match="duration_s"):
+        SimConfig.from_duration(8.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint -> serve, and the bench claim
+
+
+def test_checkpoint_to_serve_cli(tmp_path, capsys):
+    """Train a few steps, save, then --serve-sim --checkpoint-dir restores
+    and serves from the trained params without retraining."""
+    from simple_distributed_machine_learning_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    tele = str(tmp_path / "tele")
+    main(["--rank", "0", "--world_size", "1", "--model", "gpt",
+          "--stages", "2", "--epochs", "1", "--dryrun", "2",
+          "--batch-size", "8", "--microbatches", "2",
+          "--checkpoint-dir", ckpt])
+    capsys.readouterr()
+    main(["--rank", "0", "--world_size", "1", "--model", "gpt",
+          "--stages", "2", "--serve-sim", "4", "--serve-rate", "100",
+          "--serve-slots", "2", "--serve-max-new", "4",
+          "--checkpoint-dir", ckpt, "--telemetry-dir", tele])
+    out = capsys.readouterr().out
+    assert "| serve: restored params from" in out
+    assert "Train Epoch" not in out           # no retraining
+    assert "| serve: 4/4 requests completed" in out
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(tele, "metrics.jsonl")).read().splitlines()]
+    assert recs[-1]["kind"] == "serve" and recs[-1]["completed"] == 4
+
+
+def test_serve_sim_fresh_init_cli(capsys):
+    from simple_distributed_machine_learning_tpu.cli import main
+
+    main(["--rank", "0", "--world_size", "1", "--model", "gpt",
+          "--serve-sim", "3", "--serve-rate", "100", "--serve-slots", "2",
+          "--serve-max-new", "3"])
+    out = capsys.readouterr().out
+    assert "| serve: fresh-initialized params" in out
+    assert "| serve: 3/3 requests completed" in out
+
+
+def test_serve_sim_rejects_sharded_builds():
+    from simple_distributed_machine_learning_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="dense single-device"):
+        main(["--rank", "0", "--model", "gpt", "--serve-sim", "2",
+              "--experts", "4"])
+    with pytest.raises(SystemExit, match="only supported with"):
+        main(["--rank", "0", "--model", "mlp", "--serve-sim", "2"])
+
+
+def test_bench_continuous_beats_sequential():
+    """The acceptance anchor: batched continuous decoding sustains higher
+    aggregate tokens/sec than sequential one-request-at-a-time decode at
+    the same model size, with TTFT/TPOT quantiles reported."""
+    import bench
+    from bench import measure_serving
+
+    artifact = os.path.join(bench.REPO, "benchmarks", "serving.json")
+    existed = os.path.exists(artifact)
+    # rate far above service capacity so the continuous batch actually
+    # fills (at low offered load both engines are arrival-bound and tie)
+    rows = measure_serving(rates=(2000.0,), n_requests=12, slots=4,
+                           max_new=12, cfg=CFG, prompt_lens=(4, 8))
+    seq = next(r for r in rows if r["config"] == "gpt_serve_sequential")
+    cont = next(r for r in rows if r["config"] == "gpt_serve")
+    assert seq["completed"] == cont["completed"] == 12
+    assert cont["tokens_per_sec"] > seq["tokens_per_sec"], (cont, seq)
+    for r in (seq, cont):
+        for k in ("ttft_ms_p50", "ttft_ms_p95", "tpot_ms_p50",
+                  "tpot_ms_p95"):
+            assert r[k] is not None and r[k] > 0, (k, r)
+    # CPU smoke shapes never write the TPU sweep's artifact
+    assert os.path.exists(artifact) == existed
