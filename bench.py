@@ -544,7 +544,8 @@ def measure_decode(windows: int = 5, cfg=None, prompt_len: int = 32,
 
 def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                     slots: int = 8, max_new: int = 24, cfg=None,
-                    prompt_lens: tuple = (8, 16, 32)) -> list[dict]:
+                    prompt_lens: tuple = (8, 16, 32), block_size: int = 16,
+                    compare: bool = True) -> list[dict]:
     """Offered-load sweep of the continuous-batching engine (serve/).
 
     One row per Poisson arrival rate through an ``slots``-slot engine, plus
@@ -555,6 +556,12 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
     tests/test_serve.py on the CPU smoke shape). Each row reports
     throughput, TTFT/TPOT p50/p95 and mean slot occupancy — TTFT includes
     genuine queue wait once the offered load exceeds slot capacity.
+
+    With ``compare=True`` two paged-vs-dense comparisons ride along
+    (:func:`_measure_paged_vs_dense`): max sustainable concurrency at
+    fixed KV-cache bytes, and p95 decode-tick latency under a long-prompt
+    arrival (chunked vs monolithic prefill) — the two wins the paged pool
+    exists for.
 
     Engines are warmed (every prefill bucket + the decode tick compiled)
     before the trace runs, so latency columns measure serving, not XLA
@@ -578,7 +585,7 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
 
     default_shape = (cfg is None and slots == 8 and n_requests == 24
                      and max_new == 24 and rates == (2.0, 8.0, 32.0)
-                     and prompt_lens == (8, 16, 32))
+                     and prompt_lens == (8, 16, 32) and block_size == 16)
     cfg = cfg or GPTConfig(vocab=8192, seq_len=256, d_model=512, n_heads=8,
                            n_layers=4)
     if max(prompt_lens) + max_new > cfg.seq_len:
@@ -588,7 +595,8 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
     stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
 
     def run(rate, n_slots, label):
-        engine = InferenceEngine(stages, cfg, n_slots=n_slots)
+        engine = InferenceEngine(stages, cfg, n_slots=n_slots,
+                                 block_size=block_size)
         # warm every compiled shape OUTSIDE the measured trace: one tiny
         # request per prompt-length bucket (prefill shapes) + decode ticks
         for t0 in prompt_lens:
@@ -613,6 +621,12 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
 
     rows = [run(max(rates), 1, "gpt_serve_sequential")]
     rows += [run(r, slots, "gpt_serve") for r in rates]
+    if compare:
+        rows += _measure_paged_vs_dense(stages, cfg, slots=slots,
+                                        n_requests=n_requests,
+                                        max_new=max_new,
+                                        prompt_lens=prompt_lens,
+                                        block_size=block_size)
     if default_shape:
         with open(os.path.join(REPO, "benchmarks", "serving.json"),
                   "w") as f:
@@ -620,6 +634,131 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                        "backend": rows[0]["backend"], "rows": rows},
                       f, indent=2)
     return rows
+
+
+def _measure_paged_vs_dense(stages, cfg, slots: int, n_requests: int,
+                            max_new: int, prompt_lens: tuple,
+                            block_size: int,
+                            parts: tuple = ("fixed_mem", "longprompt"),
+                            ) -> list[dict]:
+    """The two paged-pool claims, measured head to head (ROADMAP item #1):
+
+    1. *Fixed KV memory, max sustainable concurrency* — a dense pool of
+       ``mem_slots`` rows vs a paged pool of the SAME bytes
+       (``mem_slots * blocks_per_seq`` blocks) given slots to spare. A
+       burst workload arrives all at once; the peak number of
+       simultaneously active requests is recorded. Dense caps at
+       ``mem_slots`` (a row is reserved at ``max_len`` whether used or
+       not); paged admits until actual blocks run out, so with requests
+       shorter than ``max_len`` it sustains strictly more.
+
+    2. *Prefill stall, p95 tick latency* — short requests decode steadily
+       while one LONG prompt arrives mid-flight. Dense/monolithic runs the
+       whole prompt inside one tick (every co-resident stalls for it);
+       paged/chunked spreads it over ``block_size``-token chunks, so the
+       worst decode tick shrinks. Per-tick wall latency is measured around
+       ``engine.step()`` after the long submit.
+    """
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.serve import (
+        InferenceEngine,
+    )
+
+    rng = np.random.default_rng(7)
+    dev = {"device_kind": jax.devices()[0].device_kind,
+           "backend": jax.default_backend()}
+
+    def _burst(engine, specs):
+        """Submit everything at t=0; drive to empty; return (peak
+        concurrent active, completed, tokens/sec)."""
+        handles = [engine.submit(**sp) for sp in specs]
+        peak, toks = 0, 0
+        t0 = _time.perf_counter()
+        while engine.busy:
+            toks += engine.step()
+            peak = max(peak, engine.pool.n_active)
+        wall = _time.perf_counter() - t0
+        done = sum(1 for h in handles if h.state == "done")
+        return peak, done, round(toks / wall, 1)
+
+    def _spec(t0, i):
+        return dict(prompt=rng.integers(0, cfg.vocab, t0).astype(np.int32),
+                    max_new_tokens=max_new, seed=1000 + i)
+
+    # -- 1. fixed-memory concurrency --------------------------------------
+    out = []
+    mem_slots = max(2, slots // 4)          # the dense pool being matched
+    bps = -(-cfg.seq_len // block_size)     # blocks per max_len sequence
+    n_blocks = mem_slots * bps              # same bytes as the dense rows
+    rows_per_req = max(prompt_lens) + max_new - 1
+    blocks_per_req = -(-rows_per_req // block_size)
+    paged_slots = min(32, max(mem_slots + 1, n_blocks // blocks_per_req))
+    burst = [_spec(prompt_lens[i % len(prompt_lens)], i)
+             for i in range(max(n_requests, 2 * paged_slots))]
+    for label, kw in (
+            ("gpt_serve_dense_fixed_mem",
+             dict(n_slots=mem_slots, kv_layout="dense")),
+            ("gpt_serve_paged_fixed_mem",
+             dict(n_slots=paged_slots, kv_layout="paged",
+                  block_size=block_size, n_blocks=n_blocks))):
+        if "fixed_mem" not in parts:
+            break
+        engine = InferenceEngine(stages, cfg, **kw)
+        warm = [_spec(t0, 500) for t0 in prompt_lens]
+        for sp in warm:
+            engine.submit(**{**sp, "max_new_tokens": 2})
+        engine.drain()
+        peak, done, tps = _burst(engine, burst)
+        out.append({
+            "config": label, "n_slots": kw["n_slots"],
+            "kv_bytes": int(engine.pool.kc.nbytes + engine.pool.vc.nbytes),
+            "n_requests": len(burst), "completed": done,
+            "max_concurrent": peak, "tokens_per_sec": tps, **dev,
+        })
+
+    # -- 2. long-prompt prefill stall -------------------------------------
+    # the stress case: a prompt near the sequence budget, so the monolithic
+    # prefill tick dwarfs a decode tick
+    long_len = cfg.seq_len - max_new
+    n_short = max(2, slots // 2)
+    for label, kw in (
+            ("gpt_serve_dense_longprompt",
+             dict(n_slots=n_short + 1, kv_layout="dense")),
+            ("gpt_serve_paged_chunked_longprompt",
+             dict(n_slots=n_short + 1, kv_layout="paged",
+                  block_size=block_size, prefill_chunk=block_size))):
+        if "longprompt" not in parts:
+            break
+        engine = InferenceEngine(stages, cfg, **kw)
+        # warm the exact compiled shapes: short prefill, long prefill
+        # (its chunk lengths), the decode tick
+        engine.submit(**{**_spec(min(prompt_lens), 600),
+                         "max_new_tokens": 2})
+        engine.submit(**{**_spec(long_len, 601), "max_new_tokens": 2})
+        engine.drain()
+        for i in range(n_short):
+            engine.submit(**_spec(min(prompt_lens), 700 + i))
+        for _ in range(3):                    # steady decode underway
+            engine.step()
+        engine.submit(**{**_spec(long_len, 800), "max_new_tokens": max_new})
+        tick_ms = []
+        while engine.busy:
+            t0 = _time.perf_counter()
+            engine.step()
+            tick_ms.append((_time.perf_counter() - t0) * 1e3)
+        out.append({
+            "config": label, "n_slots": kw["n_slots"],
+            "long_prompt_len": long_len, "n_short": n_short,
+            "tick_ms_p50": round(float(np.percentile(tick_ms, 50)), 3),
+            "tick_ms_p95": round(float(np.percentile(tick_ms, 95)), 3),
+            "tick_ms_max": round(max(tick_ms), 3),
+            "n_ticks": len(tick_ms), **dev,
+        })
+    return out
 
 
 def _measure_jax_cpu_baseline() -> float:
@@ -821,18 +960,17 @@ def main() -> None:
         _run_decode()
     if args.serve:
         for srow in measure_serving():
-            print(json.dumps({
-                "metric": f"{srow['config']}_tokens_per_sec",
-                "value": srow["tokens_per_sec"],
-                "unit": "tokens/sec",
-                "rate": srow["rate"],
-                "n_slots": srow["n_slots"],
-                "ttft_ms_p50": srow["ttft_ms_p50"],
-                "ttft_ms_p95": srow["ttft_ms_p95"],
-                "tpot_ms_p50": srow["tpot_ms_p50"],
-                "tpot_ms_p95": srow["tpot_ms_p95"],
-                "slot_occupancy_mean": srow["slot_occupancy_mean"],
-            }))
+            line = {"metric": srow["config"], "n_slots": srow["n_slots"]}
+            # sweep rows report throughput+latency; the paged-vs-dense
+            # comparison rows report concurrency / tick-latency instead
+            for k in ("tokens_per_sec", "rate", "ttft_ms_p50",
+                      "ttft_ms_p95", "tpot_ms_p50", "tpot_ms_p95",
+                      "slot_occupancy_mean", "kv_bytes", "max_concurrent",
+                      "long_prompt_len", "tick_ms_p50", "tick_ms_p95",
+                      "tick_ms_max"):
+                if srow.get(k) is not None:
+                    line[k] = srow[k]
+            print(json.dumps(line))
         if not names:
             return
     rows = []

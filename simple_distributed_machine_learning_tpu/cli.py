@@ -153,8 +153,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="for --model=gpt: skip training and serve N "
                         "simulated requests through the continuous-batching "
                         "inference engine (serve/): seeded Poisson arrivals, "
-                        "FCFS admission into a slot-based KV-cache pool, "
-                        "EOS/budget retirement freeing slots mid-flight; "
+                        "FCFS admission into a block-table paged KV-cache "
+                        "pool (prefix sharing + copy-on-write + chunked "
+                        "prefill), EOS/budget retirement freeing memory "
+                        "mid-flight; "
                         "params restore from --checkpoint-dir when a "
                         "checkpoint exists, else fresh init; TTFT/TPOT and "
                         "occupancy metrics land in --telemetry-dir")
@@ -167,6 +169,24 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--serve-max-new', type=int, default=16, metavar="T",
                    help="with --serve-sim: tokens generated per request "
                         "(EOS may retire a request earlier)")
+    g.add_argument('--serve-block-size', type=int, default=16, metavar="B",
+                   help="with --serve-sim: positions per K/V block of the "
+                        "paged cache pool (serve/slots.py PagedKVPool) — "
+                        "smaller blocks waste less tail memory and share "
+                        "prefixes at finer grain, larger blocks gather "
+                        "fewer pages per attention step")
+    g.add_argument('--serve-prefill-chunk', type=int, default=0, metavar="C",
+                   help="with --serve-sim: prompt positions prefilled per "
+                        "engine tick (chunked prefill — each tick runs at "
+                        "most one chunk, then the batched decode step, so "
+                        "a long prompt cannot stall in-flight decodes); "
+                        "0 = whole prompt in one chunk")
+    g.add_argument('--serve-shared-prefix', type=int, default=0, metavar="N",
+                   help="with --serve-sim: prepend ONE seeded common "
+                        "N-token prefix to every simulated prompt (the "
+                        "system-prompt case) — the paged pool serves the "
+                        "prefix from shared physical blocks, copy-on-write "
+                        "at divergence")
     g.add_argument('--text-corpus', default=None, metavar="PATH",
                    help="for --model=gpt: train on the BYTES of this local "
                         "file (vocab=256, next-byte LM, contiguous "
@@ -576,7 +596,24 @@ def _run_serve(args, n_stages: int, key) -> None:
     if args.serve_max_new < 1:
         raise SystemExit(f"--serve-max-new must be >= 1, got "
                          f"{args.serve_max_new}")
+    if args.serve_block_size < 1:
+        raise SystemExit(f"--serve-block-size must be >= 1, got "
+                         f"{args.serve_block_size}")
+    if args.serve_prefill_chunk < 0:
+        raise SystemExit(f"--serve-prefill-chunk must be >= 1 (or 0 for "
+                         f"whole-prompt chunks), got "
+                         f"{args.serve_prefill_chunk}")
+    if args.serve_shared_prefix < 0:
+        raise SystemExit(f"--serve-shared-prefix must be >= 0, got "
+                         f"{args.serve_shared_prefix}")
     cfg = GPTConfig(vocab=256 if args.text_corpus else 128)
+    longest = args.serve_shared_prefix + max(GPT_SERVE_PROMPTS)
+    if longest + 1 > cfg.seq_len:
+        raise SystemExit(
+            f"--serve-shared-prefix {args.serve_shared_prefix} leaves no "
+            f"room to generate: prefix + longest simulated prompt "
+            f"({max(GPT_SERVE_PROMPTS)}) + 1 token must fit seq_len "
+            f"{cfg.seq_len}")
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
     params = None
     ckpt = (os.path.join(args.checkpoint_dir, "state.npz")
@@ -603,16 +640,20 @@ def _run_serve(args, n_stages: int, key) -> None:
         print("| serve: fresh-initialized params"
               + (f" (no checkpoint at {ckpt})" if ckpt else ""))
     metrics = ServeMetrics(outdir=args.telemetry_dir)
-    engine = InferenceEngine(stages, cfg, params=params,
-                             n_slots=args.serve_slots, metrics=metrics)
-    max_new = min(args.serve_max_new, cfg.seq_len - max(GPT_SERVE_PROMPTS))
+    engine = InferenceEngine(
+        stages, cfg, params=params, n_slots=args.serve_slots,
+        block_size=args.serve_block_size,
+        prefill_chunk=(args.serve_prefill_chunk or None),
+        metrics=metrics)
+    max_new = min(args.serve_max_new, cfg.seq_len - longest)
     if max_new < args.serve_max_new:
         print(f"| serve: --serve-max-new {args.serve_max_new} clamped to "
               f"{max_new} (seq_len {cfg.seq_len} minus the longest "
-              f"{max(GPT_SERVE_PROMPTS)}-token simulated prompt)")
+              f"{longest}-token simulated prompt)")
     sim = SimConfig(n_requests=args.serve_sim, rate=args.serve_rate,
                     seed=args.seed, prompt_lens=GPT_SERVE_PROMPTS,
-                    max_new_tokens=max_new)
+                    max_new_tokens=max_new,
+                    shared_prefix_len=args.serve_shared_prefix)
     report = simulate(engine, sim)
     s = metrics.summary()
     print(f"| serve: {report['completed']}/{report['n_requests']} requests "
@@ -621,8 +662,17 @@ def _run_serve(args, n_stages: int, key) -> None:
           f"ttft p50/p95 {s['ttft_ms_p50']}/{s['ttft_ms_p95']} ms, "
           f"tpot p50/p95 {s['tpot_ms_p50']}/{s['tpot_ms_p95']} ms, "
           f"occupancy {s['slot_occupancy_mean']}")
+    print(f"| serve: paged pool {s['blocks_in_use']}/{s['blocks_total']} "
+          f"blocks in use ({s['blocks_cached']} cached), "
+          f"{s['kv_bytes_resident']} KV bytes resident, "
+          f"{s['prefix_hit_blocks']} prefix-share hits, "
+          f"{s['cow_copies']} CoW copies, "
+          f"prefill chunk p50/p95 {s['prefill_chunk_ms_p50']}/"
+          f"{s['prefill_chunk_ms_p95']} ms")
     if args.telemetry_dir:
         metrics.emit(extra={"rate": sim.rate, "n_slots": args.serve_slots,
+                            "block_size": args.serve_block_size,
+                            "shared_prefix": args.serve_shared_prefix,
                             "completed": report["completed"]})
     if not report["all_completed"]:
         raise SystemExit(1)

@@ -610,6 +610,24 @@ def _cache_dtype(cache_dtype):
     return jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
 
 
+# Built decode-path programs, keyed by their STATIC config. Every function
+# cached here closes over shape scalars only — params (and therefore the
+# stages' weights and layer count) arrive as traced ARGUMENTS — so two
+# builds with the same key return one shared jitted callable and its
+# compiled executables. Build-time validation still runs per call (it
+# checks the CALLER's stages); only the trace/compile work is shared.
+# This is what keeps a fleet of serving engines (and a test suite full of
+# them) from recompiling identical programs per instance.
+_DECODE_BUILD_CACHE: dict = {}
+
+
+def _memo_build(key: tuple, build):
+    fn = _DECODE_BUILD_CACHE.get(key)
+    if fn is None:
+        fn = _DECODE_BUILD_CACHE[key] = build()
+    return fn
+
+
 def _dense_block_prefill(bp, h, li, kc, vc, prompt_len, n_heads):
     """One block over the whole prompt [b, T0, d], recording cache row
     ``li`` for positions [0, prompt_len). K/V are cast to the cache's dtype
@@ -853,9 +871,13 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
 
     The reference has no inference path at all (eval only,
     ``/root/reference/simple_distributed.py:119-132``).
-    """
-    from jax import lax
 
+    Builds are memoized on their static config (``_DECODE_BUILD_CACHE``):
+    the program traces everything model-shaped from ``params``, so two
+    calls with the same (cfg, lengths, sampling, cache dtype) share one
+    jitted callable — and its compiled executables — even across stages
+    builds.
+    """
     if cfg.n_seq > 1:
         raise ValueError(
             "cached decode is single-device; rebuild the stages with n_seq=1 "
@@ -866,6 +888,15 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
     H, d = cfg.n_heads, cfg.d_model
     dh = d // H
     cd = _cache_dtype(cache_dtype)
+    key_ = ("cached_decoder", cfg, prompt_len, n_new, temperature, top_k,
+            top_p, jnp.dtype(cd).name)
+    return _memo_build(key_, lambda: _build_cached_decoder(
+        total, prompt_len, n_new, H, dh, cd, temperature, top_k, top_p))
+
+
+def _build_cached_decoder(total, prompt_len, n_new, H, dh, cd,
+                          temperature, top_k, top_p):
+    from jax import lax
 
     _merged = _merged_stage_trees
     _head_row = _head_logprobs
@@ -968,25 +999,28 @@ def make_slot_prefill(stages, cfg: GPTConfig, max_len: int,
     _validate_slot_build(stages, cfg, max_len, "make_slot_prefill")
     H = cfg.n_heads
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def prefill(params, kc, vc, prompt, slot, key_data, temperature,
-                top_k, top_p):
-        embed, blocks, head = _merged_stage_trees(params)
-        t0 = prompt.shape[1]
-        ids = prompt.astype(jnp.int32)
-        h = embedding_lookup(embed["tok"], ids) + embed["pos"][:t0]
-        for li, bp in enumerate(blocks):
-            q, k_, v = _dense_qkv(bp, h, H)               # [1, H, T0, dh]
-            kc = jax.lax.dynamic_update_slice(
-                kc, k_.astype(kc.dtype)[None], (li, slot, 0, 0, 0))
-            vc = jax.lax.dynamic_update_slice(
-                vc, v.astype(vc.dtype)[None], (li, slot, 0, 0, 0))
-            h = _dense_attn_tail(bp, h, causal_attention_core(q, k_, v))
-        row = _head_logprobs(head, h[:, -1])[0]           # [V]
-        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
-        return kc, vc, tok, kd
+    def build():
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(params, kc, vc, prompt, slot, key_data, temperature,
+                    top_k, top_p):
+            embed, blocks, head = _merged_stage_trees(params)
+            t0 = prompt.shape[1]
+            ids = prompt.astype(jnp.int32)
+            h = embedding_lookup(embed["tok"], ids) + embed["pos"][:t0]
+            for li, bp in enumerate(blocks):
+                q, k_, v = _dense_qkv(bp, h, H)           # [1, H, T0, dh]
+                kc = jax.lax.dynamic_update_slice(
+                    kc, k_.astype(kc.dtype)[None], (li, slot, 0, 0, 0))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, v.astype(vc.dtype)[None], (li, slot, 0, 0, 0))
+                h = _dense_attn_tail(bp, h, causal_attention_core(q, k_, v))
+            row = _head_logprobs(head, h[:, -1])[0]       # [V]
+            tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
+            return kc, vc, tok, kd
 
-    return prefill
+        return prefill
+
+    return _memo_build(("slot_prefill", cfg, max_len), build)
 
 
 def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads):
@@ -1035,19 +1069,206 @@ def make_slot_decode_step(stages, cfg: GPTConfig, max_len: int,
     _validate_slot_build(stages, cfg, max_len, "make_slot_decode_step")
     H = cfg.n_heads
 
+    def build():
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, kc, vc, toks, pos, key_data, temps, top_ks,
+                 top_ps):
+            embed, blocks, head = _merged_stage_trees(params)
+            pe = jnp.take(embed["pos"], pos, axis=0)[:, None]  # [S, 1, d]
+            h = embedding_lookup(embed["tok"], toks[:, None]) + pe
+            for li, bp in enumerate(blocks):
+                h, kc, vc = _dense_block_step_slots(bp, h, li, kc, vc,
+                                                    pos, H)
+            rows = _head_logprobs(head, h[:, 0])               # [S, V]
+            toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
+                                               top_ks, top_ps)
+            return kc, vc, toks2, kd2
+
+        return step
+
+    return _memo_build(("slot_decode", cfg, max_len), build)
+
+
+def _validate_paged_build(stages, cfg: GPTConfig, max_len: int,
+                          block_size: int, caller: str) -> None:
+    """Paged-op validation: the slot-op restrictions plus a sane block."""
+    _validate_slot_build(stages, cfg, max_len, caller)
+    if block_size < 1:
+        raise ValueError(f"{caller} needs block_size >= 1, got {block_size}")
+
+
+def _gather_paged_rows(cache_l: jax.Array, table: jax.Array) -> jax.Array:
+    """Assemble a sequence's contiguous K or V row from the paged pool.
+
+    ``cache_l``: one layer's blocks ``[n_blocks, H, bs, dh]``; ``table``:
+    logical->physical block ids, ``[NB]`` (one sequence) or ``[S, NB]``
+    (one per slot). Returns ``[..., H, NB*bs, dh]`` with position ``p``
+    of the sequence at flattened row index ``p`` — EXACTLY the dense
+    layout's row order, so the attention math downstream is unchanged and
+    the trailing garbage rows (trash-block entries past the allocated
+    span) are removed by the same position mask that already hides
+    not-yet-written dense rows."""
+    rows = cache_l[table]                     # [..., NB, H, bs, dh]
+    rows = jnp.moveaxis(rows, -4, -3)         # [..., H, NB, bs, dh]
+    return rows.reshape(*rows.shape[:-3],
+                        rows.shape[-3] * rows.shape[-2], rows.shape[-1])
+
+
+def make_paged_prefill_chunk(stages, cfg: GPTConfig, max_len: int,
+                             block_size: int, cache_dtype=None):
+    """Chunked serving prefill into paged blocks: ``chunk(params, kc, vc,
+    tokens [1, c], p0, table [NB], key_data, temperature, top_k, top_p) ->
+    (kc, vc, token, key_data)``.
+
+    Runs ONE request's prompt positions ``[p0, p0+c)`` through every block
+    (batch 1, the solo decoder's math via the shared :func:`_dense_qkv` /
+    :func:`_dense_attn_tail`), scattering each position's K/V into its
+    physical block (``table[p // bs]``, offset ``p % bs``) and attending
+    the gathered block row masked to ``<= position`` — which covers both
+    earlier chunks (already in the cache, including SHARED prefix blocks
+    another request prefilled) and the chunk's own freshly written rows.
+    The engine interleaves these chunks with decode ticks so a long prompt
+    never stalls in-flight requests; the last chunk's final position feeds
+    the head and samples the request's first token (:func:`_sample_dyn` —
+    the engine discards the sampled token and key for non-final chunks, so
+    the request's key stream advances exactly once, at the same point as
+    its solo decode).
+
+    Retraces per distinct chunk length (like :func:`make_slot_prefill`
+    retraces per prompt length). Bit-exactness vs the solo
+    ``make_cached_decoder`` holds for f32 caches: the chunk reads earlier
+    K/V back out of the cache, so a bf16 cache rounds where the solo
+    monolithic prefill attends fresh f32 K/V — the one place the paged
+    path's parity is dtype-conditional (the decode tick round-trips the
+    cache in BOTH paths, so it is exempt).
+
+    ``kc``/``vc`` (``[L, n_blocks+1, H, block_size, dh]``) are donated —
+    the engine always threads the returned buffers back into the pool.
+    """
+    _validate_paged_build(stages, cfg, max_len, block_size,
+                          "make_paged_prefill_chunk")
+    H, bs = cfg.n_heads, block_size
+    dh = cfg.d_model // H
+    return _memo_build(("paged_chunk", cfg, max_len, block_size),
+                       lambda: _build_paged_prefill_chunk(H, bs, dh))
+
+
+def _build_paged_prefill_chunk(H, bs, dh):
     @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def step(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+    def chunk(params, kc, vc, tokens, p0, table, key_data, temperature,
+              top_k, top_p):
+        embed, blocks, head = _merged_stage_trees(params)
+        c = tokens.shape[1]
+        ids = tokens.astype(jnp.int32)
+        pos_emb = jax.lax.dynamic_slice_in_dim(embed["pos"], p0, c, 0)
+        h = embedding_lookup(embed["tok"], ids) + pos_emb
+        idx = p0 + jnp.arange(c)
+        phys = table[idx // bs]                       # [c]
+        off = idx % bs
+        span = table.shape[0] * bs
+        live = (jnp.arange(span)[None, :] <= idx[:, None])[None, None]
+        for li, bp in enumerate(blocks):
+            q, k_, v = _dense_qkv(bp, h, H)           # [1, H, c, dh]
+            kc = kc.at[li, phys, :, off, :].set(
+                k_[0].swapaxes(0, 1).astype(kc.dtype))
+            vc = vc.at[li, phys, :, off, :].set(
+                v[0].swapaxes(0, 1).astype(vc.dtype))
+            krow = _gather_paged_rows(kc[li], table)  # [H, span, dh]
+            vrow = _gather_paged_rows(vc[li], table)
+            scores = jnp.einsum("bhqd,hkd->bhqk", q, krow) / math.sqrt(dh)
+            scores = jnp.where(live, scores, -jnp.inf)
+            a = jnp.einsum("bhqk,hkd->bhqd",
+                           jax.nn.softmax(scores, axis=-1), vrow)
+            h = _dense_attn_tail(bp, h, a)
+        row = _head_logprobs(head, h[:, -1])[0]       # [V]
+        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
+        return kc, vc, tok, kd
+
+    return chunk
+
+
+def make_paged_decode_step(stages, cfg: GPTConfig, max_len: int,
+                           block_size: int, cache_dtype=None):
+    """Paged serving decode tick: ``step(params, kc, vc, toks [S], pos [S],
+    tables [S, NB], key_data [S, 2], temps [S], top_ks [S], top_ps [S]) ->
+    (kc, vc, next_toks [S], next_key_data [S, 2])``.
+
+    The block-gather twin of :func:`make_slot_decode_step`: ONE batched
+    token step over all slots, but each slot's K/V row is assembled from
+    its block table (:func:`_gather_paged_rows`) instead of a dense pool
+    row, and its new K/V lands via a per-slot scatter into physical block
+    ``tables[s, pos // bs]`` at offset ``pos % bs``. Values for live
+    positions are bit-identical to the dense layout's (same numbers,
+    different storage), the mask removes everything else, so the PR-5
+    bit-exactness anchor carries over unchanged.
+
+    The dense pool's stale-write safety argument does NOT carry over: a
+    non-decoding slot's table entries may alias blocks reused by a live
+    request, so the ENGINE routes those slots' tick inputs to the trash
+    block (``pos = 0``, all-trash table) — their garbage K/V lands where
+    no real table points. ``kc``/``vc`` are donated (one in-place pool
+    update per tick).
+    """
+    _validate_paged_build(stages, cfg, max_len, block_size,
+                          "make_paged_decode_step")
+    H, bs = cfg.n_heads, block_size
+    dh = cfg.d_model // H
+    return _memo_build(("paged_decode", cfg, max_len, block_size),
+                       lambda: _build_paged_decode_step(H, bs, dh))
+
+
+def _build_paged_decode_step(H, bs, dh):
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
+             top_ps):
         embed, blocks, head = _merged_stage_trees(params)
         pe = jnp.take(embed["pos"], pos, axis=0)[:, None]     # [S, 1, d]
         h = embedding_lookup(embed["tok"], toks[:, None]) + pe
+        phys = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                                   axis=1)[:, 0]              # [S]
+        off = pos % bs
+        span = tables.shape[1] * bs
+        live = (jnp.arange(span)[None, None, None, :]
+                <= pos[:, None, None, None])
         for li, bp in enumerate(blocks):
-            h, kc, vc = _dense_block_step_slots(bp, h, li, kc, vc, pos, H)
+            q, knew, vnew = _dense_qkv(bp, h, H)              # [S, H, 1, dh]
+            kc = kc.at[li, phys, :, off, :].set(
+                knew[:, :, 0, :].astype(kc.dtype))
+            vc = vc.at[li, phys, :, off, :].set(
+                vnew[:, :, 0, :].astype(vc.dtype))
+            krow = _gather_paged_rows(kc[li], tables)         # [S,H,span,dh]
+            vrow = _gather_paged_rows(vc[li], tables)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, krow) / math.sqrt(dh)
+            scores = jnp.where(live, scores, -jnp.inf)
+            a = jnp.einsum("bhqk,bhkd->bhqd",
+                           jax.nn.softmax(scores, axis=-1), vrow)
+            h = _dense_attn_tail(bp, h, a)
         rows = _head_logprobs(head, h[:, 0])                  # [S, V]
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
         return kc, vc, toks2, kd2
 
     return step
+
+
+def make_paged_block_copy():
+    """The copy-on-write device op: ``copy(kc, vc, dst, src) -> (kc, vc)``
+    duplicates one physical block's rows across every layer before a
+    divergent write. Buffers are donated so XLA updates the pool in place
+    instead of materializing a second pool; ``dst``/``src`` are traced
+    scalars so one compiled program serves every copy."""
+    def build():
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def copy(kc, vc, dst, src):
+            ks = jax.lax.dynamic_slice_in_dim(kc, src, 1, 1)
+            vs = jax.lax.dynamic_slice_in_dim(vc, src, 1, 1)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, ks, dst, 1)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, vs, dst, 1)
+            return kc, vc
+
+        return copy
+
+    return _memo_build(("paged_block_copy",), build)
 
 
 def decoder_from_pipeline(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,

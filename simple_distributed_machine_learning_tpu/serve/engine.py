@@ -1,30 +1,44 @@
 """The continuous-batching inference engine: submit / step / drain.
 
 :class:`InferenceEngine` is the serving API over the slot-wise model ops
-(``models/gpt.py::make_slot_prefill`` / ``make_slot_decode_step``), the
-KV-cache pool and the FCFS scheduler:
+(``models/gpt.py``), the KV-cache pool and the FCFS scheduler:
 
 - ``submit(prompt, ...) -> Request`` enqueues one sequence with its own
   sampling params and seeded key stream, and returns the live handle
   (``handle.tokens`` grows as the engine runs; ``on_token`` streams);
-- ``step()`` is one *tick*: admit waiting requests into free slots (one
-  prefill each — compiled per prompt length), then ONE batched decode step
-  over all slots (one compiled program regardless of occupancy), then
-  retire finished requests so their slots free for the next tick;
-- ``drain()`` ticks until queue and slots are empty.
+- ``step()`` is one *tick*; ``drain()`` ticks until queue and slots are
+  empty.
+
+Two KV-cache layouts (``kv_layout``):
+
+- ``"paged"`` (default) — block-table paged pool (``serve/slots.py::
+  PagedKVPool``) with prefix sharing, copy-on-write and CHUNKED prefill:
+  each tick runs at most one prefill chunk (``prefill_chunk`` prompt
+  positions of the oldest still-prefilling request) and then ONE batched
+  block-gather decode step over every decoding slot — a long prompt no
+  longer freezes in-flight requests, and admission is gated on free
+  BLOCKS (the request's worst-case footprint after prefix sharing), not
+  free rows. Non-decoding slots' tick writes are routed to the pool's
+  trash block (see the stale-write note in ``serve/slots.py``).
+- ``"dense"`` — the PR-5 slot-row pool: admission prefills the whole
+  prompt in one shot (``make_slot_prefill``) and every occupied slot
+  decodes each tick. Kept as the measured baseline of
+  ``bench.py --serve``'s paged-vs-dense comparison.
 
 Device state is exactly the pool's K/V buffers; everything else (positions,
-last tokens, key streams, request lifecycle) is host-side numpy assembled
-into each tick's inputs — the scheduler stays plain Python while every FLOP
-runs inside the two jitted programs.
+last tokens, block tables, key streams, request lifecycle) is host-side
+numpy assembled into each tick's inputs — the scheduler stays plain Python
+while every FLOP runs inside the compiled programs.
 
 Correctness anchor: a request's tokens are bit-exact vs decoding it alone
 via ``make_cached_decoder`` with the same seed (tests/test_serve.py) —
-admission order, co-residents, and occupancy cannot change anyone's output.
+admission order, co-residents, occupancy, paged blocks, SHARED prefixes and
+chunk boundaries cannot change anyone's output.
 """
 
 from __future__ import annotations
 
+import collections
 import time
 
 import numpy as np
@@ -39,7 +53,10 @@ from simple_distributed_machine_learning_tpu.serve.request import (
 from simple_distributed_machine_learning_tpu.serve.scheduler import (
     FCFSScheduler,
 )
-from simple_distributed_machine_learning_tpu.serve.slots import KVCachePool
+from simple_distributed_machine_learning_tpu.serve.slots import (
+    KVCachePool,
+    PagedKVPool,
+)
 
 # sampling-param sentinels (models/gpt.py::_sample_dyn): 0 disables top-k,
 # anything > 1 disables top-p
@@ -56,33 +73,72 @@ class InferenceEngine:
     ``Pipeline.unpack``). ``max_len`` caps each slot's prompt+generation
     budget (defaults to ``cfg.seq_len``); ``cache_dtype`` is the pool's
     storage dtype (bf16 halves pool memory, the ``_cache_dtype`` rule).
+
+    Paged knobs (``kv_layout="paged"``): ``block_size`` positions per K/V
+    block; ``n_blocks`` pool capacity (default: the dense pool's capacity,
+    ``n_slots * ceil(max_len/block_size)`` — shrink it to serve more slots
+    than the memory could densely back); ``prefill_chunk`` prompt positions
+    per prefill chunk (``None`` = the whole remaining prompt in one chunk).
     """
 
     def __init__(self, stages, cfg, *, params=None, n_slots: int = 4,
                  max_len: int | None = None, cache_dtype=None,
+                 kv_layout: str = "paged", block_size: int = 16,
+                 n_blocks: int | None = None, prefill_chunk: int | None = None,
                  metrics: ServeMetrics | None = None,
                  scheduler: FCFSScheduler | None = None,
                  clock=time.monotonic) -> None:
         from simple_distributed_machine_learning_tpu.models.gpt import (
+            make_paged_block_copy,
+            make_paged_decode_step,
+            make_paged_prefill_chunk,
             make_slot_decode_step,
             make_slot_prefill,
         )
+        if kv_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'dense', got {kv_layout!r}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 (or None for whole-prompt "
+                f"chunks), got {prefill_chunk}")
+        if kv_layout == "dense" and (prefill_chunk is not None
+                                     or n_blocks is not None):
+            raise ValueError(
+                "prefill_chunk/n_blocks are paged-pool knobs; the dense "
+                "layout prefills whole prompts into fixed rows")
         self.cfg = cfg
+        self.kv_layout = kv_layout
+        self.prefill_chunk = prefill_chunk
         self.params = (params if params is not None
                        else [s.params for s in stages])
         self.max_len = int(max_len if max_len is not None else cfg.seq_len)
         n_layers = sum(len(p["blocks"]) for p in self.params)
-        self.pool = KVCachePool(n_layers, n_slots, cfg.n_heads, self.max_len,
-                                cfg.d_model // cfg.n_heads, cache_dtype)
-        self._prefill = make_slot_prefill(stages, cfg, self.max_len,
-                                          cache_dtype)
-        self._decode = make_slot_decode_step(stages, cfg, self.max_len,
-                                             cache_dtype)
+        head_dim = cfg.d_model // cfg.n_heads
+        if kv_layout == "paged":
+            self.pool = PagedKVPool(n_layers, n_slots, cfg.n_heads,
+                                    self.max_len, head_dim, cache_dtype,
+                                    block_size=block_size, n_blocks=n_blocks)
+            self._chunk_prefill = make_paged_prefill_chunk(
+                stages, cfg, self.max_len, block_size, cache_dtype)
+            self._decode = make_paged_decode_step(
+                stages, cfg, self.max_len, block_size, cache_dtype)
+            self._copy_block = make_paged_block_copy()
+        else:
+            self.pool = KVCachePool(n_layers, n_slots, cfg.n_heads,
+                                    self.max_len, head_dim, cache_dtype)
+            self._prefill = make_slot_prefill(stages, cfg, self.max_len,
+                                              cache_dtype)
+            self._decode = make_slot_decode_step(stages, cfg, self.max_len,
+                                                 cache_dtype)
         self.scheduler = scheduler or FCFSScheduler(self.pool)
         self.metrics = metrics
         self._clock = clock
         self._next_rid = 0
         self.requests: dict[int, Request] = {}
+        # rids admitted but not yet fully prefilled, admission order (the
+        # chunked-prefill work queue; always empty in dense layout)
+        self._prefilling: collections.deque[int] = collections.deque()
         # per-request last-emit timestamps for TPOT accounting
         self._last_emit: dict[int, float] = {}
 
@@ -126,21 +182,35 @@ class InferenceEngine:
         return r
 
     def step(self) -> int:
-        """One tick (admit -> batched decode -> retire); returns the number
-        of tokens emitted. A true no-op returning 0 when idle — idle ticks
-        touch no metrics, so a polling loop cannot drag the occupancy
-        histogram toward zero."""
+        """One tick; returns the number of tokens emitted. A true no-op
+        returning 0 when idle — idle ticks touch no metrics, so a polling
+        loop cannot drag the occupancy histogram toward zero.
+
+        Dense tick: admit (whole-prompt prefill each) -> batched decode ->
+        retire. Paged tick: admit (board slots, match prefixes, reserve
+        blocks) -> ONE prefill chunk of the oldest prefilling request ->
+        batched block-gather decode over the DECODING slots -> retire.
+        """
         if not self.busy:
             return 0
-        emitted = self._admit()
-        # occupancy the batched decode actually RUNS at — sampled before
-        # same-tick retirement so short requests cannot bias it low
-        decode_active = self.pool.n_active
-        emitted += self._decode_tick()
+        if self.kv_layout == "dense":
+            emitted = self._admit_dense()
+            # occupancy the batched decode actually RUNS at — sampled before
+            # same-tick retirement so short requests cannot bias it low
+            decode_active = self.pool.n_active
+            emitted += self._decode_tick_dense()
+        else:
+            self._admit_paged()
+            emitted = self._prefill_tick()
+            decoding = self._decoding_slots()
+            decode_active = len(decoding)
+            emitted += self._decode_tick_paged(decoding)
         if self.metrics is not None:
-            self.metrics.on_tick(self.scheduler.queue_depth,
-                                 self.pool.n_active, self.pool.n_slots,
-                                 decode_active=decode_active)
+            self.metrics.on_tick(
+                self.scheduler.queue_depth, self.pool.n_active,
+                self.pool.n_slots, decode_active=decode_active,
+                block_stats=(self.pool.stats()
+                             if self.kv_layout == "paged" else None))
         return emitted
 
     def drain(self, max_ticks: int | None = None) -> list[Request]:
@@ -157,9 +227,9 @@ class InferenceEngine:
             ticks += 1
         return [r for r in self.requests.values() if r.state == DONE]
 
-    # -- tick internals ----------------------------------------------------
+    # -- dense tick internals ---------------------------------------------
 
-    def _admit(self) -> int:
+    def _admit_dense(self) -> int:
         emitted = 0
         for r in self.scheduler.admit():
             t0 = int(r.prompt.shape[0])
@@ -186,10 +256,126 @@ class InferenceEngine:
                 self.pool.seat(r.slot, t0, tok)
         return emitted
 
-    def _decode_tick(self) -> int:
+    def _decode_tick_dense(self) -> int:
         active = self.pool.active_slots()
         if not active:
             return 0
+        kd, temps, top_ks, top_ps = self._sampling_inputs(active)
+        kc, vc, toks, kd2 = self._decode(
+            self.params, self.pool.kc, self.pool.vc,
+            self.pool.last_token.copy(), self.pool.positions.copy(),
+            kd, temps, top_ks, top_ps)
+        self.pool.kc, self.pool.vc = kc, vc
+        return self._emit_decoded(active, toks, kd2)
+
+    # -- paged tick internals ---------------------------------------------
+
+    def _admit_paged(self) -> None:
+        """Board waiting requests. The scheduler's admit loop already bound
+        each sequence to its slot (prefix matched, shared blocks
+        referenced, worst-case budget reserved — ``PagedKVPool.bind_seq``)
+        and parked the first position to compute in ``r.prefill_pos``. No
+        model FLOPs here — prefill happens chunk by chunk in
+        :meth:`_prefill_tick`."""
+        for r in self.scheduler.admit():
+            self._prefilling.append(r.rid)
+
+    def _prefill_tick(self) -> int:
+        """At most ONE prefill chunk per tick — the scheduler's budget that
+        keeps a long prompt from stalling every decode tick. Processes the
+        oldest still-prefilling request (FCFS, matching admission order);
+        the final chunk samples the request's first token (TTFT endpoint)
+        and registers its prompt blocks for future prefix sharing."""
+        if not self._prefilling:
+            return 0
+        r = self.requests[self._prefilling[0]]
+        plen = int(r.prompt.shape[0])
+        p0 = r.prefill_pos
+        c = (plen - p0 if self.prefill_chunk is None
+             else min(self.prefill_chunk, plen - p0))
+        t_start = self._clock()
+        self._ensure_writable_range(r.slot, p0, c)
+        kc, vc, tok, kd = self._chunk_prefill(
+            self.params, self.pool.kc, self.pool.vc,
+            r.prompt[None, p0:p0 + c], np.int32(p0),
+            self.pool.device_table(r.slot), r.key_data,
+            np.float32(r.temperature),
+            np.int32(r.top_k if r.top_k is not None else _NO_TOP_K),
+            np.float32(r.top_p if r.top_p is not None else _NO_TOP_P))
+        self.pool.kc, self.pool.vc = kc, vc
+        tok = int(np.asarray(tok))     # host sync: honest chunk timing
+        now = self._clock()
+        if self.metrics is not None:
+            self.metrics.on_prefill_chunk((now - t_start) * 1e3)
+        if p0 + c < plen:
+            # mid-prompt chunk: the sampled token AND returned key are
+            # discarded — the request's key stream advances exactly once,
+            # at the final chunk, where its solo decode would split too
+            r.prefill_pos = p0 + c
+            return 0
+        self._prefilling.popleft()
+        r.prefill_pos = None
+        r.key_data = np.asarray(kd)
+        r.first_token_time = now
+        self._last_emit[r.rid] = now
+        r.emit(tok)
+        if self.metrics is not None:
+            self.metrics.on_first_token(r.ttft_s)
+        # publish the prompt's blocks BEFORE any same-tick retirement so
+        # even a 1-token request leaves its prefix reusable (cached blocks
+        # survive end_seq as reclaimable)
+        self.pool.register_prefix(r.slot, r.prompt)
+        reason = r.finished_by(tok)
+        if reason is not None:
+            self._finish(r, reason, now)
+        else:
+            self.pool.seat(r.slot, plen, tok)
+        return 1
+
+    def _decoding_slots(self) -> list[int]:
+        """Occupied slots whose request finished prefilling — the batched
+        decode's participants this tick (still-prefilling slots sit out)."""
+        return [s for s in self.pool.active_slots()
+                if self.requests[self.pool.occupant(s)].prefill_pos is None]
+
+    def _decode_tick_paged(self, active: list[int]) -> int:
+        if not active:
+            return 0
+        S = self.pool.n_slots
+        kd, temps, top_ks, top_ps = self._sampling_inputs(active)
+        # non-decoding slots: position 0 + all-trash table, so their
+        # garbage write lands in the trash block no table references
+        pos = np.zeros(S, np.int32)
+        toks = np.zeros(S, np.int32)
+        tables = np.full((S, self.pool.blocks_per_seq), PagedKVPool.TRASH,
+                         np.int32)
+        for s in active:
+            # on-demand block allocation as this position advances (and
+            # copy-on-write if the write block is still shared)
+            self._ensure_writable_range(s, int(self.pool.positions[s]), 1)
+            tables[s] = self.pool.device_table(s)
+            pos[s] = self.pool.positions[s]
+            toks[s] = self.pool.last_token[s]
+        kc, vc, toks2, kd2 = self._decode(
+            self.params, self.pool.kc, self.pool.vc,
+            toks, pos, tables, kd, temps, top_ks, top_ps)
+        self.pool.kc, self.pool.vc = kc, vc
+        return self._emit_decoded(active, toks2, kd2)
+
+    def _ensure_writable_range(self, slot: int, p0: int, n: int) -> None:
+        """Allocate/copy-on-write every block covering positions
+        ``[p0, p0+n)`` of ``slot``'s sequence; runs the device block copy
+        the pool asks for."""
+        for p in range(p0, p0 + n):
+            cp = self.pool.ensure_writable(slot, p)
+            if cp is not None:
+                src, dst = cp
+                self.pool.kc, self.pool.vc = self._copy_block(
+                    self.pool.kc, self.pool.vc, np.int32(dst), np.int32(src))
+
+    # -- shared tick tails -------------------------------------------------
+
+    def _sampling_inputs(self, active: list[int]):
         S = self.pool.n_slots
         kd = np.zeros((S, 2), np.uint32)
         temps = np.zeros(S, np.float32)
@@ -201,11 +387,9 @@ class InferenceEngine:
             temps[s] = r.temperature
             top_ks[s] = r.top_k if r.top_k is not None else _NO_TOP_K
             top_ps[s] = r.top_p if r.top_p is not None else _NO_TOP_P
-        kc, vc, toks, kd2 = self._decode(
-            self.params, self.pool.kc, self.pool.vc,
-            self.pool.last_token.copy(), self.pool.positions.copy(),
-            kd, temps, top_ks, top_ps)
-        self.pool.kc, self.pool.vc = kc, vc
+        return kd, temps, top_ks, top_ps
+
+    def _emit_decoded(self, active: list[int], toks, kd2) -> int:
         toks = np.asarray(toks)                  # host sync: tick endpoint
         kd2 = np.asarray(kd2)
         now = self._clock()
@@ -230,6 +414,9 @@ class InferenceEngine:
         r.done_time = now
         self._last_emit.pop(r.rid, None)
         if r.state == ACTIVE:
+            # scheduler.retire unbinds the sequence (paged: decref table
+            # blocks — registered ones stay reclaimable — and return the
+            # unused reservation) before the slot frees
             self.scheduler.retire(r, reason)
         if self.metrics is not None:
             self.metrics.on_complete()

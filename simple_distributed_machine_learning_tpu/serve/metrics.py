@@ -14,6 +14,20 @@ The serving-standard latency split, as registry instruments:
   ``serve_tokens_generated_total`` (counters) and ``serve_tokens_per_sec``
   (gauge over the wall-clock window from first submit to last token).
 
+Paged-pool instruments (populated only by ``kv_layout="paged"`` engines —
+the engine hands the pool's stats to :meth:`ServeMetrics.on_tick`):
+
+- ``serve_blocks_in_use`` / ``serve_blocks_free`` / ``serve_blocks_cached``
+  / ``serve_blocks_total`` (gauges) — block-pool occupancy: live working
+  set, allocatable headroom, reclaimable prefix cache;
+- ``serve_kv_bytes_resident`` (gauge) — bytes of K/V live requests
+  actually pin (the number the paged layout shrinks vs dense rows);
+- ``serve_prefix_hit_blocks_total`` / ``serve_cow_copies_total`` /
+  ``serve_block_evictions_total`` (counters) — prefix-share hits at
+  admission, copy-on-write block copies, LRU cache evictions;
+- ``serve_prefill_chunk_ms`` (histogram) — per-chunk prefill latency: the
+  quantity chunked prefill bounds so decode ticks stay steady.
+
 ``emit()`` writes one ``kind: "serve"`` record to ``metrics.jsonl`` and
 refreshes ``metrics.prom`` — the same two artifact formats the training
 telemetry session emits, so one scrape config covers both.
@@ -31,6 +45,14 @@ from simple_distributed_machine_learning_tpu.telemetry.registry import (
 
 METRICS_FILE = "metrics.jsonl"
 PROM_FILE = "metrics.prom"
+
+# pool-stat counter keys -> instrument names (the pool reports lifetime
+# totals; the registry's counters are fed the per-tick deltas)
+_POOL_COUNTERS = {
+    "prefix_hit_blocks_total": "serve_prefix_hit_blocks_total",
+    "cow_copies_total": "serve_cow_copies_total",
+    "evictions_total": "serve_block_evictions_total",
+}
 
 
 class ServeMetrics:
@@ -55,6 +77,18 @@ class ServeMetrics:
         self.completed = r.counter("serve_requests_completed_total")
         self.tokens = r.counter("serve_tokens_generated_total")
         self.tokens_per_sec = r.gauge("serve_tokens_per_sec")
+        # paged block-pool instruments (stay at zero under a dense engine;
+        # summary() includes their block only once block stats arrive)
+        self.blocks_total = r.gauge("serve_blocks_total")
+        self.blocks_in_use = r.gauge("serve_blocks_in_use")
+        self.blocks_free = r.gauge("serve_blocks_free")
+        self.blocks_cached = r.gauge("serve_blocks_cached")
+        self.kv_bytes_resident = r.gauge("serve_kv_bytes_resident")
+        self.prefill_chunk_ms = r.histogram("serve_prefill_chunk_ms")
+        self._pool_counters = {k: r.counter(v)
+                               for k, v in _POOL_COUNTERS.items()}
+        self._pool_counter_seen = dict.fromkeys(_POOL_COUNTERS, 0)
+        self._paged_seen = False
         if outdir:
             os.makedirs(outdir, exist_ok=True)
 
@@ -83,18 +117,38 @@ class ServeMetrics:
     def on_complete(self) -> None:
         self.completed.inc()
 
+    def on_prefill_chunk(self, chunk_ms: float) -> None:
+        """One prefill chunk's wall latency (paged engines; the dense
+        layout's monolithic prefill is inside TTFT instead)."""
+        self.prefill_chunk_ms.observe(chunk_ms)
+
     def on_tick(self, queue_depth: int, active: int, total: int,
-                decode_active: int | None = None) -> None:
+                decode_active: int | None = None,
+                block_stats: dict | None = None) -> None:
         """End-of-tick gauges; ``decode_active`` is the occupancy the tick's
         batched decode ran at (sampled BEFORE same-tick retirement — the
         number batching converts into throughput). Ticks that ran no decode
-        (``decode_active == 0``) skip the occupancy observation."""
+        (``decode_active == 0``) skip the occupancy observation.
+        ``block_stats`` is ``PagedKVPool.stats()`` — lifetime counters are
+        converted to registry increments here."""
         self.queue_depth.set(queue_depth)
         self.slots_active.set(active)
         self.slots_total.set(total)
         occ = active if decode_active is None else decode_active
         if occ and total:
             self.occupancy.observe(occ / total)
+        if block_stats is not None:
+            self._paged_seen = True
+            self.blocks_total.set(block_stats["blocks_total"])
+            self.blocks_in_use.set(block_stats["blocks_in_use"])
+            self.blocks_free.set(block_stats["blocks_free"])
+            self.blocks_cached.set(block_stats["blocks_cached"])
+            self.kv_bytes_resident.set(block_stats["kv_bytes_resident"])
+            for key, counter in self._pool_counters.items():
+                delta = block_stats[key] - self._pool_counter_seen[key]
+                if delta > 0:
+                    counter.inc(delta)
+                    self._pool_counter_seen[key] = block_stats[key]
 
     # -- aggregation -------------------------------------------------------
 
@@ -109,7 +163,7 @@ class ServeMetrics:
     def summary(self) -> dict:
         """The serving record block (bench rows and ``emit`` embed it)."""
         r3 = (lambda v: None if v is None else round(v, 3))
-        return {
+        out = {
             "requests_submitted": int(self.submitted.value),
             "requests_completed": int(self.completed.value),
             "tokens_generated": int(self.tokens.value),
@@ -120,6 +174,24 @@ class ServeMetrics:
             "tpot_ms_p95": r3(self.tpot_ms.quantile(0.95)),
             "slot_occupancy_mean": r3(self.occupancy.mean),
         }
+        if self._paged_seen:
+            out.update({
+                "blocks_total": int(self.blocks_total.value),
+                "blocks_in_use": int(self.blocks_in_use.value),
+                "blocks_cached": int(self.blocks_cached.value),
+                "kv_bytes_resident": int(self.kv_bytes_resident.value),
+                "prefix_hit_blocks": int(
+                    self._pool_counters["prefix_hit_blocks_total"].value),
+                "cow_copies": int(
+                    self._pool_counters["cow_copies_total"].value),
+                "block_evictions": int(
+                    self._pool_counters["evictions_total"].value),
+                "prefill_chunk_ms_p50": r3(
+                    self.prefill_chunk_ms.quantile(0.5)),
+                "prefill_chunk_ms_p95": r3(
+                    self.prefill_chunk_ms.quantile(0.95)),
+            })
+        return out
 
     def emit(self, extra: dict | None = None) -> dict | None:
         """Append one ``kind: "serve"`` JSONL record and rewrite the

@@ -5,13 +5,22 @@ The policy layer between the request queue and the KV-cache pool. FCFS
 no preemption — which keeps TTFT fairness trivial to reason about and makes
 the scheduler invariants sharp enough to pin in tests:
 
-- a request is admitted the first tick a slot is free, never before a
-  request that arrived earlier (queue order IS arrival order);
-- retirement (EOS sampled, or ``max_new_tokens`` reached) releases the slot
-  in the SAME tick, so a waiting request boards on the very next tick —
-  that mid-flight boarding is the whole point of continuous batching;
-- the pool's own guards make double-occupancy and double-release raise
-  rather than corrupt (``serve/slots.py``).
+- a request is admitted the first tick the POOL accepts it
+  (``pool.can_admit``: a free slot for the dense layout; a free slot AND
+  the block budget after prefix sharing for the paged one), never before a
+  request that arrived earlier (queue order IS arrival order — the
+  head-of-line request is probed, so a big request is never starved by
+  smaller ones slipping past it);
+- admission BINDS the sequence to its slot inside the loop
+  (``pool.bind_seq``: the paged pool matches/references shared prefix
+  blocks and reserves the worst-case budget), so a burst cannot admit
+  past the pool's actual capacity;
+- retirement (EOS sampled, or ``max_new_tokens`` reached) unbinds and
+  releases in the SAME tick, so a waiting request boards on the very next
+  tick — that mid-flight boarding is the whole point of continuous
+  batching;
+- the pool's own guards make double-occupancy, double-release and block
+  double-alloc/free raise rather than corrupt (``serve/slots.py``).
 
 Smarter policies (shortest-job-first on ``max_new_tokens``, priority
 classes) would subclass and override :meth:`FCFSScheduler.pick`.
@@ -55,11 +64,28 @@ class FCFSScheduler:
     def admit(self) -> list[Request]:
         """Board waiting requests into free slots (as many as fit), FCFS.
         Returns the newly admitted requests with ``slot`` assigned; the
-        engine prefills each one."""
+        engine prefills each one.
+
+        Admission is gated on the POOL's judgment (``pool.can_admit``), not
+        just a free slot: the dense pool's answer is "a slot is free" (the
+        row IS the whole budget), the paged pool's is "a slot is free AND
+        enough blocks remain for this request's worst-case footprint after
+        prefix sharing". The gate runs on the request :meth:`pick` actually
+        RETURNS (not a peeked head), so a subclass policy reordering the
+        queue is still budget-checked; a picked request that doesn't fit
+        goes back to the front and admission stops — head-of-line blocking,
+        no starvation of big requests behind a stream of small ones."""
         admitted = []
-        while self.queue and self.pool.n_free:
+        while self.queue:
             r = self.pick()
+            if not self.pool.can_admit(r):
+                self.queue.appendleft(r)
+                break
             r.slot = self.pool.acquire(r.rid)
+            # bind INSIDE the loop: the paged pool reserves this request's
+            # block budget here, so the next iteration's can_admit probe
+            # already sees it (a burst cannot over-admit the pool)
+            r.prefill_pos = self.pool.bind_seq(r)
             r.state = ACTIVE
             admitted.append(r)
         return admitted
@@ -71,6 +97,7 @@ class FCFSScheduler:
             raise ValueError(
                 f"request {request.rid} is not active (state "
                 f"{request.state!r}, slot {request.slot!r})")
+        self.pool.unbind_seq(request.slot)
         self.pool.release(request.slot)
         request.slot = None
         request.state = DONE

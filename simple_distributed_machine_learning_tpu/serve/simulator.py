@@ -48,6 +48,13 @@ class SimConfig:
     temperature: float = 0.8
     top_k: int | None = 8
     eos_id: int | None = None
+    # shared system prompt: prepend ONE seeded common prefix of this many
+    # tokens to every request's prompt (total length = prefix + bucket).
+    # The multi-million-user case the paged pool's prefix sharing targets:
+    # all requests reference the same physical K/V blocks for the prefix
+    # until they diverge (copy-on-write), so both the prefix's memory and
+    # its prefill compute are paid roughly once.
+    shared_prefix_len: int = 0
 
     def __post_init__(self):
         if self.n_requests < 1:
@@ -57,6 +64,9 @@ class SimConfig:
             raise ValueError(f"rate must be > 0 req/s, got {self.rate}")
         if not self.prompt_lens:
             raise ValueError("prompt_lens must be non-empty")
+        if self.shared_prefix_len < 0:
+            raise ValueError(f"shared_prefix_len must be >= 0, got "
+                             f"{self.shared_prefix_len}")
 
     @classmethod
     def from_duration(cls, rate: float, duration_s: float, **kw
@@ -76,10 +86,12 @@ def build_workload(sim: SimConfig, vocab: int) -> tuple[np.ndarray, list]:
     so two runs of the same config produce the same per-request tokens."""
     rng = np.random.default_rng(sim.seed)
     arrivals = np.cumsum(rng.exponential(1.0 / sim.rate, sim.n_requests))
+    prefix = rng.integers(0, vocab, sim.shared_prefix_len).astype(np.int32)
     specs = []
     for i in range(sim.n_requests):
         t0 = int(sim.prompt_lens[i % len(sim.prompt_lens)])
-        prompt = rng.integers(0, vocab, t0).astype(np.int32)
+        prompt = np.concatenate(
+            [prefix, rng.integers(0, vocab, t0).astype(np.int32)])
         sampled = rng.random() < sim.sampled_fraction
         specs.append(dict(
             prompt=prompt,

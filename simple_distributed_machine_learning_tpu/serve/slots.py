@@ -1,61 +1,73 @@
-"""Slot-based KV-cache pool: the serving engine's one device-resident state.
+"""KV-cache pools: the serving engine's one device-resident state.
 
-A *slot* is one row of every layer's K/V cache — the static-shape home of one
-in-flight sequence. The pool owns:
+Two layouts, one slot discipline:
 
-- device buffers ``kc``/``vc`` of shape ``[L, n_slots, H, max_len, dh]``
-  (bf16-capable via the same ``cache_dtype`` rule as every one-shot decoder:
-  ``models/gpt.py::_cache_dtype``);
-- host-side per-slot position counters (the next cache index each slot
-  writes) and last-token values — tiny arrays fed into every compiled tick;
-- the free-slot list with invariant guards: acquiring an occupied slot or
-  releasing a free one raises instead of silently corrupting a neighbor's
-  cache (the scheduler invariants pinned in tests/test_serve.py).
+- :class:`KVCachePool` — the dense PR-5 layout: a *slot* is one row of every
+  layer's K/V cache (``[L, n_slots, H, max_len, dh]``), the static-shape home
+  of one in-flight sequence. Memory is reserved at ``max_len`` per slot
+  whether the sequence uses it or not, so HBM — not compute — caps
+  concurrency. Kept as the paged pool's comparison baseline
+  (``bench.py --serve``) and for engines built with ``kv_layout="dense"``.
 
-Shapes never change at runtime: admission writes INTO a slot row at its own
-offsets, retirement just returns the row to the free list — one compiled
-decode program serves every occupancy.
+- :class:`PagedKVPool` — the block-table paged layout (vLLM-style): a global
+  pool of fixed-size K/V *blocks* (``[L, n_blocks+1, H, block_size, dh]``;
+  physical block 0 is the trash block inactive slots write into), a
+  per-slot block table mapping logical block ``j`` (positions
+  ``[j*bs, (j+1)*bs)``) to a physical block, on-demand allocation as
+  positions advance, and copy-on-write prefix sharing: requests with a
+  common prompt prefix reference the same physical blocks until they
+  diverge, and the first write into a shared block copies it first.
+  A sequence's memory footprint is ``ceil(rows/block_size)`` blocks instead
+  of a ``max_len`` row, so the same bytes sustain strictly more concurrent
+  requests (the ``bench.py --serve`` fixed-memory comparison).
 
-Stale-write safety: an idle slot keeps its stale position, and the batched
-decode step keeps writing garbage K/V there while the slot is unoccupied.
-That is safe by construction — a row at cache index ``p`` only ever becomes
-visible to attention at the tick that FIRST reaches position ``p``, and that
-same tick overwrites index ``p`` with the real K/V before attending; prefill
-likewise overwrites ``[0, prompt_len)`` on admission and resets the counter.
+Both pools share the invariant-guarded slot free list: acquiring an occupied
+slot or releasing a free one raises instead of silently corrupting a
+neighbor's cache, and the paged pool extends the discipline to blocks — no
+double allocation, no double free, no write into a block another sequence
+still references (the scheduler invariants pinned in tests/test_serve.py).
+
+Stale-write safety (dense): an idle slot keeps its stale position, and the
+batched decode step keeps writing garbage K/V there while the slot is
+unoccupied. That is safe by construction — a row at cache index ``p`` only
+ever becomes visible to attention at the tick that FIRST reaches position
+``p``, and that same tick overwrites index ``p`` with the real K/V before
+attending; prefill likewise overwrites ``[0, prompt_len)`` on admission.
+
+Stale-write safety (paged): the dense argument breaks under paging — a
+retired slot's stale block-table entries may point at physical blocks
+REUSED by a live request, so a garbage write there would corrupt a
+neighbor. The engine therefore routes every non-decoding slot's tick write
+to the trash block (``PagedKVPool.TRASH``, position 0), which no block
+table ever references.
 """
 
 from __future__ import annotations
 
+import collections
+import math
+
 import numpy as np
 
 
-class KVCachePool:
-    """Fixed-capacity slot pool; see module docstring."""
+class _SlotPoolBase:
+    """Slot occupancy accounting shared by both layouts: the free-slot list
+    with invariant guards, and the per-slot decode state (position counters
+    and last-token values — tiny host arrays fed into every compiled tick;
+    the authoritative copy lives here, not on device)."""
 
-    def __init__(self, n_layers: int, n_slots: int, n_heads: int,
-                 max_len: int, head_dim: int, cache_dtype=None) -> None:
+    def __init__(self, n_slots: int, max_len: int) -> None:
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2 (a prompt token plus a "
                              f"generated one), got {max_len}")
-        import jax.numpy as jnp
-
-        from simple_distributed_machine_learning_tpu.models.gpt import (
-            _cache_dtype,
-        )
         self.n_slots = n_slots
         self.max_len = max_len
-        shape = (n_layers, n_slots, n_heads, max_len, head_dim)
-        cd = _cache_dtype(cache_dtype)
-        self.kc = jnp.zeros(shape, cd)
-        self.vc = jnp.zeros(shape, cd)
-        # host mirrors of per-slot decode state (assembled into each tick's
-        # device inputs; the authoritative copy lives here, not on device)
         self.positions = np.zeros(n_slots, np.int32)
         self.last_token = np.zeros(n_slots, np.int32)
         self._occupant: list[int | None] = [None] * n_slots
-        self._free: list[int] = list(range(n_slots))[::-1]   # pop() -> slot 0 first
+        self._free: list[int] = list(range(n_slots))[::-1]   # pop() -> slot 0
 
     # -- occupancy accounting ---------------------------------------------
 
@@ -77,8 +89,8 @@ class KVCachePool:
         """Claim a free slot for request ``rid``; raises when full or on a
         double-occupancy attempt (the invariant, not a best-effort)."""
         if not self._free:
-            raise RuntimeError("KVCachePool.acquire on a full pool — the "
-                               "scheduler must check n_free first")
+            raise RuntimeError("slot acquire on a full pool — the scheduler "
+                               "must check can_admit first")
         slot = self._free.pop()
         if self._occupant[slot] is not None:     # pragma: no cover - guard
             raise RuntimeError(
@@ -108,3 +120,405 @@ class KVCachePool:
     def advance(self, slot: int, next_token: int) -> None:
         self.positions[slot] += 1
         self.last_token[slot] = int(next_token)
+
+    # -- layout hooks (scheduler-driven) -----------------------------------
+
+    def bind_seq(self, request) -> int | None:
+        """Attach an admitted request's sequence state to its slot. The
+        dense layout has none (the row IS the state): returns ``None``.
+        The paged override matches/reserves blocks and returns the first
+        prompt position prefill must compute. MUST run inside the
+        admission loop, immediately after the slot acquire — the next
+        head-of-line ``can_admit`` probe has to see this request's
+        reservation, or a burst admits past the pool's capacity."""
+        return None
+
+    def unbind_seq(self, slot: int) -> None:
+        """Release the slot's sequence state at retirement (before the slot
+        itself frees). Dense layout: nothing to do."""
+
+
+class KVCachePool(_SlotPoolBase):
+    """Dense fixed-capacity slot pool; see module docstring."""
+
+    def __init__(self, n_layers: int, n_slots: int, n_heads: int,
+                 max_len: int, head_dim: int, cache_dtype=None) -> None:
+        super().__init__(n_slots, max_len)
+        import jax.numpy as jnp
+
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            _cache_dtype,
+        )
+        shape = (n_layers, n_slots, n_heads, max_len, head_dim)
+        cd = _cache_dtype(cache_dtype)
+        self.kc = jnp.zeros(shape, cd)
+        self.vc = jnp.zeros(shape, cd)
+
+    def can_admit(self, request) -> bool:
+        """Dense admission gate: one free slot IS the whole budget (the row
+        reserves ``max_len`` positions up front)."""
+        return self.n_free > 0
+
+
+class PagedKVPool(_SlotPoolBase):
+    """Block-table paged K/V pool with prefix sharing; see module docstring.
+
+    Block lifecycle: a physical block is *free* (on the free list), *live*
+    (``ref > 0`` request references), or *cached* (``ref == 0`` but holding
+    registered prefix content — reclaimable, evicted LRU when the free list
+    runs dry). ``ref`` counts live REQUEST references only; the registry's
+    interest is the cached flag, so a block can outlive its last request
+    exactly as long as the pool isn't under pressure.
+
+    Copy-on-write: writers must call :meth:`ensure_writable` before landing
+    K/V at a position. A block referenced by more than one request is copied
+    first (the caller performs the device copy of the ``(src, dst)`` pair
+    this returns); a block referenced once is written in place, dropping any
+    registered prefix whose covered rows the write would clobber.
+
+    Reservation accounting makes on-demand allocation safe: admission
+    reserves this sequence's worst-case block budget (its total rows minus
+    fully-shared blocks, which are never written), and every later
+    allocation draws from that reservation — so a decode tick can never find
+    the pool empty, and admission (``can_admit``) blocks exactly while
+    ``free + reclaimable - reserved`` is short.
+    """
+
+    TRASH = 0   # physical block 0: the garbage sink for non-decoding slots
+
+    def __init__(self, n_layers: int, n_slots: int, n_heads: int,
+                 max_len: int, head_dim: int, cache_dtype=None,
+                 block_size: int = 16, n_blocks: int | None = None) -> None:
+        super().__init__(n_slots, max_len)
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = block_size
+        self.blocks_per_seq = math.ceil(max_len / block_size)
+        if n_blocks is None:
+            # default: the dense pool's capacity in blocks (same worst case)
+            n_blocks = n_slots * self.blocks_per_seq
+        if n_blocks < self.blocks_per_seq:
+            raise ValueError(
+                f"n_blocks={n_blocks} cannot hold even one full sequence "
+                f"({self.blocks_per_seq} blocks of {block_size} for "
+                f"max_len={max_len})")
+        self.n_blocks = n_blocks
+        import jax.numpy as jnp
+
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            _cache_dtype,
+        )
+        cd = _cache_dtype(cache_dtype)
+        # +1: physical block 0 is the trash block, never allocated
+        shape = (n_layers, n_blocks + 1, n_heads, block_size, head_dim)
+        self.kc = jnp.zeros(shape, cd)
+        self.vc = jnp.zeros(shape, cd)
+        self.bytes_per_block = int(
+            2 * n_layers * n_heads * block_size * head_dim
+            * jnp.dtype(cd).itemsize)
+        # block bookkeeping (host-side, authoritative)
+        self.ref = np.zeros(n_blocks + 1, np.int64)
+        self._free_blocks: list[int] = list(range(1, n_blocks + 1))[::-1]
+        self._cached: dict[int, set[bytes]] = {}       # block -> prefix keys
+        self._prefix: dict[bytes, tuple[int, int]] = {}  # key -> (block, fill)
+        # bumped on every _prefix mutation (register/drop/evict): versions
+        # the per-request probe memo in _probe_cached
+        self._registry_epoch = 0
+        self._lru: collections.OrderedDict[int, None] = (
+            collections.OrderedDict())                 # reclaimable, LRU order
+        self._reserved = 0
+        # per-slot sequence state
+        self.tables: list[list[int]] = [[] for _ in range(n_slots)]
+        self._resv = np.zeros(n_slots, np.int64)
+        # lifetime counters (ServeMetrics reads the deltas)
+        self.prefix_hit_blocks_total = 0
+        self.cow_copies_total = 0
+        self.evictions_total = 0
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def blocks_in_use(self) -> int:
+        """Blocks referenced by live requests (cached-only blocks excluded —
+        they are reclaimable memory, not working-set)."""
+        return int((self.ref[1:] > 0).sum())
+
+    @property
+    def blocks_cached(self) -> int:
+        return len(self._lru)
+
+    @property
+    def blocks_available(self) -> int:
+        """Blocks a NEW sequence could still claim: free + reclaimable
+        (cached, ref 0) minus outstanding reservations."""
+        return len(self._free_blocks) + len(self._lru) - self._reserved
+
+    def bytes_resident(self) -> int:
+        return self.blocks_in_use * self.bytes_per_block
+
+    @staticmethod
+    def _rows_needed(prompt_len: int, max_new_tokens: int) -> int:
+        # positions written: prefill [0, prompt_len) + one decode write per
+        # consumed token — the final emitted token is never consumed, so the
+        # highest written position is prompt_len + max_new - 2
+        return prompt_len + max_new_tokens - 1
+
+    def blocks_for(self, rows: int) -> int:
+        return math.ceil(rows / self.block_size)
+
+    # -- admission ---------------------------------------------------------
+
+    def can_admit(self, request) -> bool:
+        """Paged admission gate: a free slot AND enough blocks for this
+        request's worst-case budget after prefix sharing (shared FULL blocks
+        are never written, so they cost nothing; a shared partial tail still
+        budgets one block for its copy-on-write).
+
+        Chain blocks sitting in the reclaimable LRU (cached, ref 0) are
+        counted OUT of availability here: sharing them revives them
+        (``_ref_block`` pulls them from the LRU), which shrinks
+        ``blocks_available`` without consuming reservation — counting them
+        as both "shared, free of charge" and "reclaimable headroom" would
+        approve a request ``begin_seq`` cannot actually fund."""
+        if not self._free:
+            return False
+        _shared_len, chain = self._probe_cached(request)
+        n_shared_full = sum(1 for _, fill in chain if fill == self.block_size)
+        n_shared_reclaimable = sum(1 for b, _ in chain if self.ref[b] == 0)
+        budget = self.blocks_for(
+            self._rows_needed(int(np.asarray(request.prompt).shape[0]),
+                              request.max_new_tokens)) - n_shared_full
+        return budget <= self.blocks_available - n_shared_reclaimable
+
+    def begin_seq(self, slot: int, prompt: np.ndarray,
+                  max_new_tokens: int) -> int:
+        """Attach a sequence to an acquired slot: match the longest
+        registered prompt prefix (incref'ing the shared blocks into this
+        slot's table) and reserve the worst-case budget for the rest.
+        Returns ``shared_len`` — the first prompt position the engine's
+        chunked prefill must actually compute (always < prompt_len: at
+        least the last prompt position is recomputed so the first token is
+        sampled from a real forward pass)."""
+        if self.tables[slot] or self._resv[slot]:
+            raise RuntimeError(
+                f"begin_seq on slot {slot} with a live block table or "
+                f"reservation — the previous sequence was never ended")
+        prompt = np.asarray(prompt)
+        shared_len, chain = self._probe_prefix(prompt)
+        for block, _fill in chain:
+            self._ref_block(block)
+            self.tables[slot].append(block)
+        n_shared_full = sum(1 for _, fill in chain if fill == self.block_size)
+        budget = self.blocks_for(
+            self._rows_needed(int(prompt.shape[0]), max_new_tokens)
+        ) - n_shared_full
+        if budget > self.blocks_available:
+            raise RuntimeError(
+                f"begin_seq short of blocks (need {budget}, have "
+                f"{self.blocks_available}) — the scheduler must check "
+                f"can_admit first")
+        self._reserved += budget
+        self._resv[slot] = budget
+        self.prefix_hit_blocks_total += len(chain)
+        return shared_len
+
+    def bind_seq(self, request) -> int | None:
+        return self.begin_seq(request.slot, request.prompt,
+                              request.max_new_tokens)
+
+    def unbind_seq(self, slot: int) -> None:
+        self.end_seq(slot)
+
+    def end_seq(self, slot: int) -> None:
+        """Detach the slot's sequence: decref every table block (cached
+        blocks become reclaimable, uncached ones free) and return the unused
+        reservation. The slot itself is released separately (scheduler)."""
+        for block in self.tables[slot]:
+            self._unref_block(block)
+        self.tables[slot] = []
+        self._reserved -= int(self._resv[slot])
+        self._resv[slot] = 0
+
+    # -- write-path allocation + copy-on-write -----------------------------
+
+    def ensure_writable(self, slot: int, position: int
+                        ) -> tuple[int, int] | None:
+        """Make ``position``'s block privately writable by ``slot``'s
+        sequence, allocating on demand as positions advance. Returns a
+        ``(src, dst)`` physical pair when copy-on-write fired — the CALLER
+        must copy the device block rows before writing — else ``None``.
+
+        In-place writes into a singly-referenced block drop any registered
+        prefix whose covered rows extend past the write offset (the write
+        would silently corrupt what the registry promises future sharers).
+        """
+        if not 0 <= position < self.max_len:
+            raise ValueError(f"position {position} outside [0, "
+                             f"{self.max_len})")
+        table = self.tables[slot]
+        j = position // self.block_size
+        if j > len(table):          # pragma: no cover - guard
+            raise RuntimeError(
+                f"slot {slot} write at position {position} skips logical "
+                f"block {len(table)} — positions must advance contiguously")
+        if j == len(table):
+            table.append(self._alloc_block(slot))
+            return None
+        phys = table[j]
+        if self.ref[phys] > 1:
+            dst = self._alloc_block(slot)
+            table[j] = dst
+            self._unref_block(phys)
+            self.cow_copies_total += 1
+            return (phys, dst)
+        # singly-referenced: in-place, but invalidate stale prefix promises
+        off = position % self.block_size
+        for key in list(self._cached.get(phys, ())):
+            if self._prefix[key][1] > off:
+                self._drop_key(key)
+        return None
+
+    def _alloc_block(self, slot: int) -> int:
+        if self._resv[slot] <= 0:   # pragma: no cover - guard
+            raise RuntimeError(
+                f"slot {slot} allocates past its reservation — the "
+                f"admission budget was computed wrong")
+        if self._free_blocks:
+            block = self._free_blocks.pop()
+        elif self._lru:
+            block, _ = self._lru.popitem(last=False)   # evict LRU cached
+            for key in list(self._cached.get(block, ())):
+                del self._prefix[key]
+            self._cached.pop(block, None)
+            self._registry_epoch += 1
+            self.evictions_total += 1
+        else:                       # pragma: no cover - guard
+            raise RuntimeError(
+                "block pool exhausted despite reservation accounting — "
+                "free/reserve bookkeeping corrupted")
+        if self.ref[block] != 0:    # pragma: no cover - guard
+            raise RuntimeError(
+                f"allocated block {block} has ref {self.ref[block]} — "
+                f"double allocation")
+        if block == self.TRASH:     # pragma: no cover - guard
+            raise RuntimeError("the trash block leaked into the free list")
+        self.ref[block] = 1
+        self._resv[slot] -= 1
+        self._reserved -= 1
+        return block
+
+    def _ref_block(self, block: int) -> None:
+        if self.ref[block] == 0:
+            # was cached-reclaimable; sharing revives it
+            self._lru.pop(block, None)
+        self.ref[block] += 1
+
+    def _unref_block(self, block: int) -> None:
+        if self.ref[block] <= 0:
+            raise RuntimeError(f"unref of unreferenced block {block} — "
+                               f"double free")
+        self.ref[block] -= 1
+        if self.ref[block] == 0:
+            if self._cached.get(block):
+                self._lru[block] = None        # reclaimable, newest last
+            else:
+                self._free_blocks.append(block)
+
+    # -- prefix registry ---------------------------------------------------
+
+    def _probe_cached(self, request) -> tuple[int, list[tuple[int, int]]]:
+        """Probe memoized on the request, keyed by the registry epoch — a
+        blocked head-of-line request is re-probed every tick by
+        ``can_admit``, and without the memo each probe re-hashes up to
+        ``block_size`` prompt prefixes per block. The epoch bumps on every
+        registry mutation, so a stale chain can never be returned."""
+        memo = getattr(request, "_prefix_probe", None)
+        if memo is not None and memo[0] == self._registry_epoch:
+            return memo[1], memo[2]
+        shared_len, chain = self._probe_prefix(np.asarray(request.prompt))
+        request._prefix_probe = (self._registry_epoch, shared_len, chain)
+        return shared_len, chain
+
+    def _probe_prefix(self, prompt: np.ndarray
+                      ) -> tuple[int, list[tuple[int, int]]]:
+        """Longest registered chain prefixing ``prompt`` (capped at
+        ``prompt_len - 1`` so at least one position is always recomputed).
+        Returns ``(shared_len, [(block, fill), ...])`` without mutating."""
+        prompt = np.asarray(prompt, np.int32)
+        cap = int(prompt.shape[0]) - 1
+        bs = self.block_size
+        chain: list[tuple[int, int]] = []
+        shared = 0
+        j = 0
+        while True:
+            hit = None
+            # the longest key covering block j that still prefixes prompt:
+            # full block first, then partial fills from longest down
+            for length in range(min(cap, (j + 1) * bs), j * bs, -1):
+                entry = self._prefix.get(prompt[:length].tobytes())
+                if entry is not None:
+                    hit = (entry[0], length - j * bs)
+                    break
+            if hit is None:
+                break
+            chain.append(hit)
+            shared = j * bs + hit[1]
+            if hit[1] < bs:         # partial tail ends the chain
+                break
+            j += 1
+        return shared, chain
+
+    def register_prefix(self, slot: int, prompt: np.ndarray) -> None:
+        """Publish ``slot``'s freshly prefilled prompt blocks to the
+        registry: one key per full block boundary plus the partial tail, so
+        later requests with the same prefix share instead of recompute.
+        First writer wins — an existing key keeps its block."""
+        prompt = np.asarray(prompt, np.int32)
+        bs = self.block_size
+        table = self.tables[slot]
+        plen = int(prompt.shape[0])
+        for j in range(self.blocks_for(plen)):
+            fill = min(plen - j * bs, bs)
+            key = prompt[:j * bs + fill].tobytes()
+            if key in self._prefix:
+                continue
+            block = table[j]
+            self._prefix[key] = (block, fill)
+            self._cached.setdefault(block, set()).add(key)
+            self._registry_epoch += 1
+
+    def _drop_key(self, key: bytes) -> None:
+        block, _ = self._prefix.pop(key)
+        self._registry_epoch += 1
+        keys = self._cached.get(block)
+        if keys:
+            keys.discard(key)
+            if not keys:
+                del self._cached[block]
+                if self.ref[block] == 0 and block in self._lru:
+                    # was reclaimable via the registry alone — hand the
+                    # block back outright
+                    del self._lru[block]
+                    self._free_blocks.append(block)
+
+    # -- tick inputs -------------------------------------------------------
+
+    def device_table(self, slot: int) -> np.ndarray:
+        """This slot's block table padded to the static program width with
+        trash entries (masked out by position in the compiled step)."""
+        t = np.full(self.blocks_per_seq, self.TRASH, np.int32)
+        table = self.tables[slot]
+        t[:len(table)] = table
+        return t
+
+    def stats(self) -> dict:
+        return {
+            "blocks_total": self.n_blocks,
+            "blocks_in_use": self.blocks_in_use,
+            "blocks_cached": self.blocks_cached,
+            "blocks_free": len(self._free_blocks),
+            "kv_bytes_resident": self.bytes_resident(),
+            "prefix_hit_blocks_total": self.prefix_hit_blocks_total,
+            "cow_copies_total": self.cow_copies_total,
+            "evictions_total": self.evictions_total,
+        }
