@@ -231,6 +231,47 @@ def build_parser() -> argparse.ArgumentParser:
                         "step latency every Nth step; 1 = exact per-step "
                         "latency, larger N keeps async dispatch overlapped "
                         "and attributes each fenced window to its N steps")
+    g.add_argument('--max-steps-per-epoch', type=int, default=None,
+                   metavar='N',
+                   help="cap every training epoch at N batches (full "
+                        "epochs by default) — the knob short CI runs and "
+                        "the --chaos smoke use to keep multi-epoch runs "
+                        "cheap without collapsing them to one epoch like "
+                        "--dryrun does")
+    g.add_argument('--chaos', type=str, default=None, metavar='SPEC',
+                   help="resilience drill (resilience/): train under a "
+                        "deterministic fault-injection schedule with the "
+                        "elastic checkpoint-restart supervisor — on an "
+                        "injected host-kill (or other recoverable fault) "
+                        "the run restores the latest VALID checkpoint from "
+                        "--checkpoint-dir (checksum-verified manifest), "
+                        "repacks it onto the surviving stage count and "
+                        "resumes. SPEC grammar: 'kind@site[=step]"
+                        "[,key=val...]' entries joined by ';' — e.g. "
+                        "'host-kill@train.step=6'; kinds: host-kill, "
+                        "frozen-peer, slow-tick, ckpt-write-crash, "
+                        "wedged-device. Requires --checkpoint-dir; "
+                        "--model mlp or gpt")
+    g.add_argument('--chaos-stages', type=str, default=None, metavar='S1,S2',
+                   help="with --chaos: the stage-count ladder the "
+                        "supervisor falls back through on host/peer loss "
+                        "(largest first, e.g. 2,1 = restart-and-repack "
+                        "onto 1 stage after losing a host at 2); default: "
+                        "stay at the launch stage count")
+    g.add_argument('--chaos-max-restarts', type=int, default=3,
+                   help="with --chaos: recoverable-failure restart budget "
+                        "before the run FAILS loudly")
+    g.add_argument('--scenario', type=str, default=None, metavar='NAME',
+                   help="run one SLO-gated serving scenario "
+                        "(resilience/scenarios.py): deterministic bursty/"
+                        "diurnal/multi-tenant traffic with per-class "
+                        "TTFT/TPOT targets through the continuous-batching "
+                        "engine on a virtual clock; priority scheduling "
+                        "with prefill preemption protects interactive "
+                        "traffic. Exits nonzero unless every class attains "
+                        "its SLOs and every request completes; per-class "
+                        "attainment lands in --telemetry-dir. NAME 'list' "
+                        "prints the catalog")
     g.add_argument('--dryrun', type=int, default=0, metavar='N',
                    help="smoke mode: train only N batches of a single epoch "
                         "(then the normal eval) and exit — the cheap "
@@ -339,6 +380,15 @@ def _dispatch(args) -> None:
         raise SystemExit("--ep needs --model=gpt with --experts > 0")
     if args.generate > 0 and args.model != "gpt":
         raise SystemExit("--generate is only supported with --model=gpt")
+    if args.max_steps_per_epoch is not None and args.max_steps_per_epoch < 1:
+        raise SystemExit(f"--max-steps-per-epoch must be >= 1, got "
+                         f"{args.max_steps_per_epoch}")
+    if args.scenario is not None:
+        _run_scenario(args, n_stages, key)
+        return
+    if args.chaos is not None:
+        _run_chaos(args, n_stages, key)
+        return
     if args.serve_sim > 0:
         if args.model != "gpt":
             raise SystemExit("--serve-sim is only supported with "
@@ -415,9 +465,10 @@ def _train_config(args):
         TrainConfig,
     )
     return TrainConfig(
-        # --dryrun N: N batches of one epoch, the cheap end-to-end smoke
+        # --dryrun N: N batches of one epoch, the cheap end-to-end smoke;
+        # --max-steps-per-epoch caps every epoch without collapsing to one
         epochs=1 if args.dryrun else args.epochs,
-        max_steps_per_epoch=args.dryrun or None,
+        max_steps_per_epoch=args.dryrun or args.max_steps_per_epoch,
         batch_size=args.batch_size,
         learning_rate=args.lr, momentum=args.momentum,
         seed=args.seed, checkpoint_dir=args.checkpoint_dir,
@@ -464,7 +515,12 @@ def _make_opt(args, total_steps: int, pipe=None):
 
 
 def _total_steps(args, train_ds) -> int:
+    """The LR-schedule horizon: steps the run will actually execute —
+    honoring --max-steps-per-epoch, so a capped run's cosine/warmup
+    schedule sweeps its full range instead of idling at the initial LR."""
     per_epoch = max(1, -(-len(train_ds.x) // args.batch_size))
+    if args.max_steps_per_epoch is not None:
+        per_epoch = min(per_epoch, args.max_steps_per_epoch)
     return args.epochs * per_epoch
 
 
@@ -681,6 +737,198 @@ def _run_serve(args, n_stages: int, key) -> None:
 # prompt-length buckets of the simulated serving workload (each bucket is
 # one compiled prefill shape)
 GPT_SERVE_PROMPTS = (4, 8, 12)
+
+
+def _run_scenario(args, n_stages: int, key) -> None:
+    """--scenario NAME: one SLO-gated serving scenario (resilience/
+    scenarios.py) on a fresh-init GPT build; exits nonzero unless every
+    gated class attains its TTFT/TPOT targets and all requests complete."""
+    from simple_distributed_machine_learning_tpu.resilience.scenarios import (
+        SCENARIOS,
+        run_scenario,
+    )
+
+    if args.scenario == "list":
+        for s in SCENARIOS.values():
+            print(f"| {s.name}: {s.description}")
+        return
+    if args.scenario not in SCENARIOS:
+        raise SystemExit(
+            f"unknown --scenario {args.scenario!r}; available: "
+            f"{', '.join(sorted(SCENARIOS))} (or 'list')")
+    if args.serve_sim > 0 or args.chaos is not None:
+        raise SystemExit("--scenario runs alone (drop --serve-sim/--chaos)")
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    cfg = GPTConfig()
+    stages, _wd, _os = make_gpt_stages(key, cfg, n_stages)
+    report = run_scenario(args.scenario, stages, cfg,
+                          outdir=args.telemetry_dir)
+    print(f"| scenario {report['scenario']} ({report['scheduler']}): "
+          f"{report['completed']}/{report['n_requests']} completed, "
+          f"{report.get('preemptions', 0)} preemptions, "
+          f"faults fired: "
+          f"{report.get('faults', {}).get('total_fired', 0)}")
+    for cls, att in sorted(report["slo"].items()):
+        parts = []
+        if "ttft_attainment" in att:
+            a = att["ttft_attainment"]
+            parts.append(f"ttft p95 {att['ttft_ms_p95']} vms vs SLO "
+                         f"{att['ttft_slo_ms']} "
+                         f"({'-' if a is None else round(a, 3)})")
+        if "tpot_attainment" in att:
+            a = att["tpot_attainment"]
+            parts.append(f"tpot p95 {att['tpot_ms_p95']} vms vs SLO "
+                         f"{att['tpot_slo_ms']} "
+                         f"({'-' if a is None else round(a, 3)})")
+        print(f"| scenario:   {cls} "
+              f"[{'OK' if att['ok'] else 'VIOLATED'}] " + "; ".join(parts))
+    print(f"| scenario: SLO {'ATTAINED' if report['slo_ok'] else 'MISSED'}")
+    if not report["slo_ok"]:
+        raise SystemExit(1)
+
+
+def _run_chaos(args, n_stages: int, key) -> None:
+    """--chaos SPEC: training under a deterministic fault schedule with the
+    elastic checkpoint-restart supervisor (resilience/supervisor.py).
+
+    The supervisor rebuilds the trainer from scratch after every
+    recoverable failure — nothing in-memory survives an attempt — restoring
+    the latest checksum-valid checkpoint from the store in --checkpoint-dir
+    and repacking it onto the surviving stage count from the
+    --chaos-stages ladder. Exits 0 only when training ran to completion
+    within the restart budget.
+    """
+    import dataclasses
+
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.resilience import (
+        CheckpointStore,
+        RestartPolicy,
+        faults,
+        make_elastic_trainer,
+        supervise,
+    )
+
+    if args.model not in ("mlp", "gpt"):
+        raise SystemExit(
+            "--chaos supports --model mlp or gpt (the contiguous-split "
+            "families repack_checkpoint can rewrite across stage counts; "
+            "lenet's conv|fc split is a structural rename)")
+    if args.experts > 0 or args.sp > 1 or args.tp > 1 or args.ep > 1 \
+            or args.serve_sim > 0:
+        raise SystemExit(
+            "--chaos drills the pipeline-parallel training path: drop "
+            "--experts/--sp/--tp/--ep/--serve-sim")
+    if args.world_size > 1:
+        raise SystemExit(
+            "--chaos supervises in-process (single-process elastic "
+            "restart); multi-process peer loss is the watchdog's domain "
+            "(--peer-timeout)")
+    if not args.checkpoint_dir:
+        raise SystemExit("--chaos needs --checkpoint-dir (the supervisor "
+                         "restores from its checkpoint store)")
+    if args.chaos_max_restarts < 0:
+        raise SystemExit(f"--chaos-max-restarts must be >= 0, got "
+                         f"{args.chaos_max_restarts}")
+    try:
+        plan = faults.FaultPlan.parse(args.chaos)
+    except ValueError as e:
+        raise SystemExit(f"bad --chaos spec: {e}") from None
+    if args.chaos_stages:
+        try:
+            topologies = [int(s) for s in args.chaos_stages.split(",")]
+        except ValueError:
+            raise SystemExit(f"--chaos-stages expects a comma list of "
+                             f"stage counts, got {args.chaos_stages!r}"
+                             ) from None
+        if any(t < 1 for t in topologies):
+            raise SystemExit(f"--chaos-stages entries must be >= 1, got "
+                             f"{topologies}")
+    else:
+        topologies = [n_stages]
+
+    from simple_distributed_machine_learning_tpu.data.mnist import Dataset
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+
+    if args.model == "gpt":
+        from simple_distributed_machine_learning_tpu.data.text import (
+            synthetic_tokens,
+        )
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            GPTConfig,
+            make_gpt_stages,
+        )
+        cfg = GPTConfig(vocab=256 if args.text_corpus else 128)
+        all_data = synthetic_tokens(7000, cfg.seq_len, cfg.vocab,
+                                    seed=args.seed)
+        train_ds = Dataset(all_data.x[:6000].astype(np.float32),
+                           all_data.y[:6000])
+        test_ds = Dataset(all_data.x[6000:].astype(np.float32),
+                          all_data.y[6000:])
+
+        def build_pipe(n):
+            stages, wd, osh = make_gpt_stages(key, cfg, n)
+            mesh = make_mesh(n_stages=n, n_data=args.dp,
+                             devices=jax.devices()[:n * args.dp])
+            return Pipeline(stages, mesh, wd, osh,
+                            n_microbatches=args.microbatches,
+                            compute_dtype=_compute_dtype(args),
+                            remat=args.remat, schedule=args.schedule)
+    else:
+        from simple_distributed_machine_learning_tpu.data.mnist import (
+            load_mnist,
+        )
+        from simple_distributed_machine_learning_tpu.models.mlp import (
+            make_mlp_stages,
+        )
+        dims = [int(d) for d in args.mlp_dims.split(",")]
+        tr, te = load_mnist(args.data_root)
+        train_ds = Dataset(tr.x.reshape(len(tr.x), -1), tr.y)
+        test_ds = Dataset(te.x.reshape(len(te.x), -1), te.y)
+
+        def build_pipe(n):
+            stages, wd, od = make_mlp_stages(key, dims, n)
+            mesh = make_mesh(n_stages=n, n_data=args.dp,
+                             devices=jax.devices()[:n * args.dp])
+            return Pipeline(stages, mesh, wd, od,
+                            n_microbatches=args.microbatches,
+                            compute_dtype=_compute_dtype(args),
+                            remat=args.remat, schedule=args.schedule)
+
+    store = CheckpointStore(args.checkpoint_dir, keep=5)
+    # the store owns persistence: the Trainer's own state.npz path stays off
+    config = dataclasses.replace(_train_config(args), checkpoint_dir=None)
+    total = _total_steps(args, train_ds)
+
+    def build_trainer(n):
+        # opt_factory: the optimizer must see the ATTEMPT's pipeline
+        # (replication-weighted --clip-norm depends on the topology)
+        return make_elastic_trainer(
+            build_pipe, n, store, train_ds, test_ds, config,
+            opt_factory=lambda pipe: _make_opt(args, total, pipe))
+
+    faults.install(plan)
+    try:
+        report = supervise(
+            build_trainer, topologies,
+            policy=RestartPolicy(max_restarts=args.chaos_max_restarts))
+    finally:
+        faults.uninstall()
+    print(f"| chaos: completed after {report['restarts']} restart(s); "
+          f"attempts: "
+          + " -> ".join(f"{a['n_stages']}st/{a['outcome']}"
+                        f"{'(' + a['fault'] + ')' if 'fault' in a else ''}"
+                        for a in report["attempts"])
+          + f"; faults fired: {plan.stats()['total_fired']}")
 
 
 def _print_sample(args, trainer, cfg, test_ds) -> None:

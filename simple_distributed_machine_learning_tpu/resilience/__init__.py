@@ -1,0 +1,60 @@
+"""Resilience: deterministic fault injection, elastic restart, SLO gates.
+
+The north star serves millions of users, where host loss and load spikes
+are the steady state; this package turns the repo's isolated failure
+utilities (the dead-peer watchdog, async checkpointing with cross-topology
+repack, the seeded traffic simulator) into one tested capability:
+
+- :mod:`.faults` — seeded, reproducible fault schedules (host-kill,
+  frozen-peer, slow-tick, checkpoint-write-crash, wedged-device) injected
+  at named sites threaded through the Trainer, the checkpoint writer, the
+  watchdog, the serving engine and the bench probe (``--chaos``);
+- :mod:`.store` — checksum-validated checkpoint history with a manifest:
+  restore picks the latest checkpoint that VERIFIES, never a corrupt one;
+- :mod:`.supervisor` — the elastic checkpoint-restart loop: on a
+  recoverable failure, restore the latest valid checkpoint, repack it onto
+  the surviving stage count (``repack_packed_buffer``) and resume, with
+  bounded exponential backoff and a max-restart budget;
+- :mod:`.scenarios` — the SLO-gated serving scenario suite: deterministic
+  bursty/diurnal/multi-tenant traffic with per-class TTFT/TPOT targets,
+  priority scheduling with prefill preemption, attainment computed from
+  the telemetry registry (``--scenario``).
+
+Attribute access is lazy (PEP 562): importing the package pulls in neither
+jax nor the trainer until a symbol that needs them is touched — the faults
+module stays importable from stdlib-only contexts like the watchdog's
+monitor subprocess.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "FaultPlan": ".faults",
+    "FaultSpec": ".faults",
+    "FaultInjected": ".faults",
+    "HostLost": ".faults",
+    "DeviceWedged": ".faults",
+    "CheckpointWriteCrash": ".faults",
+    "CheckpointStore": ".store",
+    "ElasticTrainer": ".supervisor",
+    "PeerLost": ".supervisor",
+    "RestartBudgetExceeded": ".supervisor",
+    "RestartPolicy": ".supervisor",
+    "make_elastic_trainer": ".supervisor",
+    "supervise": ".supervisor",
+    "Scenario": ".scenarios",
+    "SCENARIOS": ".scenarios",
+    "VirtualClock": ".scenarios",
+    "run_scenario": ".scenarios",
+}
+
+__all__ = sorted(_EXPORTS) + ["faults", "scenarios", "store", "supervisor"]
+
+
+def __getattr__(name: str):
+    import importlib
+
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod, __name__), name)

@@ -1,0 +1,302 @@
+"""Deterministic fault injection: seeded, reproducible failure schedules.
+
+The reference program's failure story is "wait for the real thing": a dead
+peer hangs it forever, a torn checkpoint write is discovered at the next
+restore, a wedged accelerator eats a bench round (ROADMAP standing note).
+This module turns every one of those into a *scheduled, seeded event* that
+CI replays on every PR, instead of an incident someone debugs at 3am.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` entries — each one
+"fire fault KIND at injection SITE when the context matches". Instrumented
+code calls :func:`maybe_fire` at named sites; with no plan installed that is
+one global ``is None`` check (the hot-path cost of the whole harness).
+
+Fault kinds and their standard effects (applied by :func:`maybe_fire`):
+
+=================== ==================================================
+``host-kill``        raises :class:`HostLost` — the in-process stand-in
+                     for a host dying mid-step; the supervisor
+                     (``resilience/supervisor.py``) treats it exactly
+                     like a real process loss: all in-memory state is
+                     discarded, recovery is from disk only
+``frozen-peer``      holds the site for ``dur`` seconds (``plan.sleep``);
+                     at the ``watchdog.heartbeat`` site the watchdog
+                     interprets it itself (stops heartbeating, socket
+                     left open — the frozen-process signature)
+``slow-tick``        sleeps ``dur`` seconds at the site (straggler /
+                     degraded-device simulation)
+``ckpt-write-crash`` truncates the in-flight temp file and raises
+                     :class:`CheckpointWriteCrash` from inside the
+                     checkpoint writer — the mid-write crash the atomic
+                     write-then-rename discipline must survive
+``wedged-device``    raises :class:`DeviceWedged`; ``bench.py`` maps it
+                     onto the rc-17 wedged-accelerator signature
+=================== ==================================================
+
+Injection sites threaded through the stack:
+
+- ``train.step``          (``train/trainer.py``, ctx: ``step``)
+- ``ckpt.write``          (``train/checkpoint.py``, ctx: ``path``, ``tmp``)
+- ``serve.tick``          (``serve/engine.py``, ctx: ``step`` = tick index)
+- ``watchdog.heartbeat``  (``utils/failure.py``, ctx: ``rank``)
+- ``bench.probe``         (``bench.py``, ctx: ``step`` = probe attempt)
+
+Plans come from :meth:`FaultPlan.parse` (the ``--chaos`` CLI grammar),
+:meth:`FaultPlan.random` (seeded schedules — same seed, same faults), or
+explicit specs. ``install()`` makes a plan process-active; sites are
+matched by name so new subsystems opt in by calling ``maybe_fire``.
+
+Grammar (``--chaos``): entries separated by ``;``, each
+``kind@site[=step][,key=val...]`` with keys ``dur`` (seconds), ``after``
+(skip the first N matching calls), ``times`` (fire at most N times;
+0 = unlimited; default 1) and ``rank``. Examples::
+
+    host-kill@train.step=6
+    slow-tick@serve.tick,dur=0.004,after=2,times=6
+    frozen-peer@watchdog.heartbeat,rank=1
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+KINDS = ("host-kill", "frozen-peer", "slow-tick", "ckpt-write-crash",
+         "wedged-device")
+
+SITES = ("train.step", "ckpt.write", "serve.tick", "watchdog.heartbeat",
+         "bench.probe")
+
+ENV_VAR = "SDML_CHAOS"
+
+
+class FaultInjected(RuntimeError):
+    """Base of every exception an injected fault raises; carries the spec."""
+
+    def __init__(self, spec: "FaultSpec", site: str):
+        super().__init__(
+            f"injected fault {spec.kind!r} fired at site {site!r} "
+            f"(deterministic chaos schedule — resilience/faults.py)")
+        self.spec = spec
+        self.site = site
+
+
+class HostLost(FaultInjected):
+    """A host died mid-run (injected): in-memory state is gone, recovery
+    must come from the checkpoint store."""
+
+
+class DeviceWedged(FaultInjected):
+    """The accelerator stopped responding (injected): the rc-17 signature
+    bench.py's supervised smoke probe detects and retries."""
+
+
+class CheckpointWriteCrash(FaultInjected):
+    """The process crashed mid-checkpoint-write (injected): the temp file is
+    truncated; the previously committed checkpoint must stay intact."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault; see the module docstring for field semantics."""
+
+    kind: str
+    site: str
+    step: int | None = None     # fire only when ctx["step"] == step
+    rank: int | None = None     # fire only when ctx["rank"] == rank
+    after: int = 0              # skip the first N matching calls
+    times: int = 1              # max firings (0 = unlimited)
+    dur: float = 0.05           # hold/sleep seconds (slow-tick, frozen-peer)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {KINDS}")
+        if self.site not in SITES:
+            # strict: a typo'd site would silently never fire and the chaos
+            # drill would pass vacuously. A new subsystem's injection point
+            # joins SITES alongside its maybe_fire() call.
+            raise ValueError(
+                f"unknown fault site {self.site!r}; instrumented sites: "
+                f"{SITES}")
+        if self.after < 0 or self.times < 0 or self.dur < 0:
+            raise ValueError(
+                f"after/times/dur must be >= 0, got {self.after}/"
+                f"{self.times}/{self.dur}")
+
+
+class FaultPlan:
+    """A deterministic schedule of faults plus its firing state.
+
+    ``check(site, **ctx)`` matches and counts without side effects (the
+    watchdog uses it to interpret ``frozen-peer`` itself); ``fire(site,
+    **ctx)`` additionally applies each fired fault's standard effect —
+    raise, or sleep through ``self.sleep`` (injectable, so a virtual-clock
+    scenario advances simulated time instead of stalling the test).
+    """
+
+    def __init__(self, specs, sleep=time.sleep):
+        self.specs = list(specs)
+        self.sleep = sleep
+        self._seen = [0] * len(self.specs)    # matching calls per spec
+        self._fired = [0] * len(self.specs)   # firings per spec
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def parse(cls, text: str, sleep=time.sleep) -> "FaultPlan":
+        """Parse the ``--chaos`` grammar (module docstring)."""
+        specs = []
+        for raw in text.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            head, *fields = raw.split(",")
+            if "@" not in head:
+                raise ValueError(
+                    f"bad fault entry {raw!r}: expected kind@site[=step]"
+                    f"[,key=val...]")
+            kind, site = head.split("@", 1)
+            kw: dict = {"kind": kind.strip()}
+            site = site.strip()
+            if "=" in site:
+                site, step = site.split("=", 1)
+                kw["step"] = int(step)
+            kw["site"] = site
+            for field in fields:
+                if "=" not in field:
+                    raise ValueError(
+                        f"bad fault field {field!r} in {raw!r}: expected "
+                        f"key=val")
+                k, v = (s.strip() for s in field.split("=", 1))
+                if k == "dur":
+                    kw[k] = float(v)
+                elif k in ("after", "times", "rank", "step"):
+                    kw[k] = int(v)
+                else:
+                    raise ValueError(
+                        f"unknown fault field {k!r} in {raw!r}; known: "
+                        f"dur, after, times, rank, step")
+            specs.append(FaultSpec(**kw))
+        if not specs:
+            raise ValueError(f"fault plan {text!r} contains no entries")
+        return cls(specs, sleep=sleep)
+
+    @classmethod
+    def random(cls, seed: int, n: int = 3, sites=("train.step",),
+               kinds=("host-kill", "slow-tick"), max_step: int = 100,
+               sleep=time.sleep) -> "FaultPlan":
+        """A seeded random schedule: same seed, same faults, every run —
+        the property that makes a chaos soak reproducible in CI."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        steps = sorted(int(s) for s in
+                       rng.choice(max_step, size=n, replace=False))
+        specs = [FaultSpec(kind=str(rng.choice(list(kinds))),
+                           site=str(rng.choice(list(sites))),
+                           step=step)
+                 for step in steps]
+        return cls(specs, sleep=sleep)
+
+    # -- matching ----------------------------------------------------------
+
+    def check(self, site: str, **ctx) -> list[FaultSpec]:
+        """Specs firing for this call (matching + occurrence accounting,
+        no effects applied)."""
+        fired = []
+        for i, spec in enumerate(self.specs):
+            if spec.site != site:
+                continue
+            if spec.rank is not None and ctx.get("rank") != spec.rank:
+                continue
+            if spec.step is not None and ctx.get("step") != spec.step:
+                continue
+            seen = self._seen[i]
+            self._seen[i] = seen + 1
+            if seen < spec.after:
+                continue
+            if spec.times and self._fired[i] >= spec.times:
+                continue
+            self._fired[i] += 1
+            fired.append(spec)
+        return fired
+
+    def fire(self, site: str, **ctx) -> list[FaultSpec]:
+        """``check`` + standard effects. Sleeping faults are applied first
+        so a site scheduled with both a slow-tick and a host-kill stalls,
+        then dies — the order a real degrading host fails in."""
+        fired = self.check(site, **ctx)
+        for spec in fired:
+            if spec.kind in ("slow-tick", "frozen-peer"):
+                self.sleep(spec.dur)
+        for spec in fired:
+            if spec.kind == "host-kill":
+                raise HostLost(spec, site)
+            if spec.kind == "wedged-device":
+                raise DeviceWedged(spec, site)
+            if spec.kind == "ckpt-write-crash":
+                tmp = ctx.get("tmp")
+                if tmp:
+                    try:  # leave a half-written temp, like a real crash
+                        with open(tmp, "r+b") as f:
+                            f.truncate(max(0, os.path.getsize(tmp) // 2))
+                    except OSError:
+                        pass
+                raise CheckpointWriteCrash(spec, site)
+        return fired
+
+    def stats(self) -> dict:
+        """Per-spec firing counts (scenario reports embed this so a run
+        proves its faults actually happened)."""
+        return {
+            "specs": [dataclasses.asdict(s) for s in self.specs],
+            "fired": list(self._fired),
+            "total_fired": sum(self._fired),
+        }
+
+
+# -- the process-active plan (the one global the hot paths check) -----------
+
+_ACTIVE: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Make ``plan`` the process-active fault schedule (replacing any)."""
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> FaultPlan | None:
+    return _ACTIVE
+
+
+def install_from_env(var: str = ENV_VAR) -> FaultPlan | None:
+    """Install a plan from the ``SDML_CHAOS`` env var (how ``bench.py`` and
+    subprocess harnesses receive their schedule); None when unset."""
+    text = os.environ.get(var)
+    if not text:
+        return None
+    return install(FaultPlan.parse(text))
+
+
+def maybe_fire(site: str, **ctx) -> list[FaultSpec]:
+    """The instrumented-code entry point: a no-op unless a plan is active."""
+    if _ACTIVE is None:
+        return []
+    return _ACTIVE.fire(site, **ctx)
+
+
+def check(site: str, **ctx) -> list[FaultSpec]:
+    """Match without effects (callers that interpret the fault themselves,
+    e.g. the watchdog's frozen-peer); no-op unless a plan is active."""
+    if _ACTIVE is None:
+        return []
+    return _ACTIVE.check(site, **ctx)

@@ -1,0 +1,241 @@
+"""The serving scenario suite: SLO-gated, deterministic load + fault runs.
+
+A *scenario* bundles one traffic shape (``serve/simulator.py``: arrival
+pattern + multi-tenant class mix with per-class TTFT/TPOT SLOs), one engine
+configuration (slots/blocks/chunking + scheduler policy) and optionally one
+fault schedule (``resilience/faults.py``). :func:`run_scenario` drives it
+through the continuous-batching engine and then *asserts SLO attainment
+from the telemetry registry* — per-class ``serve_class_ttft_ms``/
+``serve_class_tpot_ms`` histograms answer "what fraction of requests met
+the target", and the run passes only when every gated class attains its
+SLOs AND every request completed. CI gates on the resulting
+``kind: "scenario"`` record in ``metrics.jsonl`` — "the system stayed
+within SLO under this fault + this load", not just "it finished".
+
+Determinism: scenarios run on a :class:`VirtualClock` — every clock read
+advances simulated time by a fixed quantum and ``sleep`` advances it by the
+requested amount, so latency numbers measure *scheduling structure* (ticks
+spent queued, prefill chunks, preemptions, injected stalls) rather than
+host speed. A scenario therefore produces the byte-identical report on any
+machine, which is what lets CI gate on exact SLO attainment without flake.
+SLO targets below are in virtual milliseconds against that cost model
+(~``2 * per_call_s`` per engine tick plus injected fault time); wall-clock
+runs (``virtual=False``) measure real latency instead and should gate on
+generous targets only.
+
+The catalog (also in docs/ARCHITECTURE.md):
+
+=================== =====================================================
+``steady``           single interactive class, homogeneous Poisson, FCFS —
+                     the sanity baseline: an unstressed system meets SLOs
+``burst-interactive`` bursty arrivals, interactive (priority 2) vs batch
+                     (priority 0) tenants, priority scheduling with
+                     prefill preemption protecting interactive TTFT
+``multi-tenant``     three tenants (interactive/standard/batch) over a
+                     diurnal rate cycle, priority scheduling
+``burst-slow-tick``  ``burst-interactive``'s load composed with injected
+                     slow-tick device stalls — SLOs must hold through a
+                     degraded device
+=================== =====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from simple_distributed_machine_learning_tpu.resilience import faults
+from simple_distributed_machine_learning_tpu.serve.metrics import ServeMetrics
+from simple_distributed_machine_learning_tpu.serve.scheduler import (
+    FCFSScheduler,
+    PriorityScheduler,
+)
+from simple_distributed_machine_learning_tpu.serve.simulator import (
+    SimConfig,
+    TrafficClass,
+    simulate,
+)
+
+
+class VirtualClock:
+    """Deterministic simulated time: each read costs ``per_call_s``, each
+    ``sleep(dt)`` advances ``dt``. Handed to the engine, its metrics AND
+    the simulator (plus ``FaultPlan.sleep``) so all timestamps share one
+    origin and one cost model."""
+
+    def __init__(self, per_call_s: float = 0.001) -> None:
+        if per_call_s <= 0:
+            raise ValueError(f"per_call_s must be > 0, got {per_call_s}")
+        self.per_call_s = per_call_s
+        self._t = 0.0
+
+    def __call__(self) -> float:
+        self._t += self.per_call_s
+        return self._t
+
+    def sleep(self, dt: float) -> None:
+        self._t += max(0.0, float(dt))
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One catalog entry; see the module docstring."""
+
+    name: str
+    description: str
+    sim: SimConfig
+    n_slots: int = 3
+    block_size: int = 8
+    prefill_chunk: int | None = None
+    scheduler: str = "priority"        # "fcfs" | "priority"
+    chaos: str | None = None           # FaultPlan.parse spec, or None
+    min_attainment: float = 0.9        # per-SLO pass bar
+
+    def __post_init__(self):
+        if self.scheduler not in ("fcfs", "priority"):
+            raise ValueError(
+                f"scheduler must be fcfs|priority, got {self.scheduler!r}")
+        if not 0 < self.min_attainment <= 1:
+            raise ValueError(f"min_attainment must be in (0, 1], got "
+                             f"{self.min_attainment}")
+
+
+# SLO targets are VIRTUAL milliseconds (see module docstring): an engine
+# tick costs a few virtual ms, so "TTFT <= 60 vms" reads "first token
+# within ~tens of ticks of arrival". Measured on the burst scenarios:
+# priority+preemption holds interactive p95 TTFT at ~22-25 vms (attainment
+# 1.0) while FCFS head-of-line blocking blows it to ~230-256 vms
+# (attainment 0.75/0.375 — a hard SLO failure); tests/test_scenarios.py
+# pins both sides of that gate.
+_INTERACTIVE = TrafficClass("interactive", weight=0.35, priority=2,
+                            ttft_slo_ms=60.0, tpot_slo_ms=40.0,
+                            prompt_lens=(4, 6), max_new_tokens=8)
+_STANDARD = TrafficClass("standard", weight=0.3, priority=1,
+                         ttft_slo_ms=150.0, tpot_slo_ms=60.0,
+                         prompt_lens=(8,), max_new_tokens=12)
+_BATCH = TrafficClass("batch", weight=0.35, priority=0,
+                      prompt_lens=(12,), max_new_tokens=24)
+
+SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
+    Scenario(
+        name="steady",
+        description="single interactive class, homogeneous Poisson, FCFS "
+                    "— the unstressed baseline must meet SLOs",
+        sim=SimConfig(n_requests=16, rate=12.0, seed=0,
+                      classes=(dataclasses.replace(_INTERACTIVE,
+                                                   weight=1.0),)),
+        n_slots=4, scheduler="fcfs"),
+    Scenario(
+        name="burst-interactive",
+        description="bursty arrivals, interactive vs batch tenants; "
+                    "priority scheduling + prefill preemption protect the "
+                    "interactive class's TTFT through the spikes",
+        sim=SimConfig(n_requests=28, rate=20.0, seed=0, arrival="bursty",
+                      burst_factor=6.0, burst_duty=0.2, period_s=1.0,
+                      classes=(_INTERACTIVE,
+                               dataclasses.replace(_BATCH, weight=0.65))),
+        n_slots=3, prefill_chunk=4),
+    Scenario(
+        name="multi-tenant",
+        description="three tenants (interactive/standard/batch) over a "
+                    "diurnal rate cycle, priority scheduling",
+        sim=SimConfig(n_requests=30, rate=16.0, seed=0, arrival="diurnal",
+                      diurnal_amplitude=0.8, period_s=2.0,
+                      classes=(_INTERACTIVE, _STANDARD, _BATCH)),
+        n_slots=4, prefill_chunk=4),
+    Scenario(
+        name="burst-slow-tick",
+        description="burst-interactive's load with injected slow-tick "
+                    "device stalls (deterministic chaos schedule) — SLOs "
+                    "must hold through a degraded device",
+        sim=SimConfig(n_requests=24, rate=18.0, seed=0, arrival="bursty",
+                      burst_factor=6.0, burst_duty=0.2, period_s=1.0,
+                      classes=(_INTERACTIVE,
+                               dataclasses.replace(_BATCH, weight=0.65))),
+        n_slots=3, prefill_chunk=4,
+        chaos="slow-tick@serve.tick,dur=0.004,after=5,times=10"),
+)}
+
+
+def run_scenario(scenario: Scenario | str, stages, cfg, *,
+                 outdir: str | None = None, scheduler: str | None = None,
+                 virtual: bool = True, per_call_s: float = 0.001) -> dict:
+    """Run one scenario end to end; returns the report with the SLO block.
+
+    ``stages``/``cfg``: a ``make_gpt_stages`` build (the engine's usual
+    contract). ``scheduler`` overrides the scenario's policy (the
+    FCFS-vs-priority comparison tests use this). With ``outdir`` set, the
+    serve record and a ``kind: "scenario"`` record (name, SLO attainment
+    per class, ``slo_ok``, fault stats) land in ``metrics.jsonl`` +
+    ``metrics.prom`` — the artifact CI's chaos job parses.
+
+    ``report["slo_ok"]`` is True only when every gated class attains every
+    target at ``min_attainment`` or better AND all requests completed.
+    """
+    import time
+
+    if isinstance(scenario, str):
+        if scenario not in SCENARIOS:
+            raise ValueError(
+                f"unknown scenario {scenario!r}; available: "
+                f"{sorted(SCENARIOS)} (see resilience/scenarios.py)")
+        scenario = SCENARIOS[scenario]
+    clock = VirtualClock(per_call_s) if virtual else time.monotonic
+    sleep = clock.sleep if virtual else time.sleep
+    policy = scheduler or scenario.scheduler
+    sched_cls = PriorityScheduler if policy == "priority" else FCFSScheduler
+
+    plan = None
+    if scenario.chaos:
+        plan = faults.install(faults.FaultPlan.parse(scenario.chaos,
+                                                     sleep=sleep))
+    try:
+        from simple_distributed_machine_learning_tpu.serve.engine import (
+            InferenceEngine,
+        )
+        metrics = ServeMetrics(outdir=outdir, clock=clock)
+        engine = InferenceEngine(
+            stages, cfg, n_slots=scenario.n_slots,
+            block_size=scenario.block_size,
+            prefill_chunk=scenario.prefill_chunk,
+            scheduler=sched_cls, metrics=metrics, clock=clock)
+        report = simulate(engine, scenario.sim, sleep=sleep)
+    finally:
+        if plan is not None:
+            faults.uninstall()
+
+    slo: dict = {}
+    ok = bool(report["all_completed"])
+    for tc in scenario.sim.classes:
+        if tc.ttft_slo_ms is None and tc.tpot_slo_ms is None:
+            continue
+        att = metrics.attainment(tc.name, ttft_slo_ms=tc.ttft_slo_ms,
+                                 tpot_slo_ms=tc.tpot_slo_ms)
+        cls_ok = True
+        for key in ("ttft_attainment", "tpot_attainment"):
+            if key in att:
+                cls_ok &= (att[key] is not None
+                           and att[key] >= scenario.min_attainment)
+        att["ok"] = cls_ok
+        slo[tc.name] = att
+        ok &= cls_ok
+    report["scenario"] = scenario.name
+    report["scheduler"] = policy
+    report["slo"] = slo
+    report["slo_ok"] = ok
+    if plan is not None:
+        report["faults"] = plan.stats()
+    if outdir:
+        from simple_distributed_machine_learning_tpu.telemetry.registry import (
+            append_jsonl,
+        )
+        metrics.emit(extra={"scenario": scenario.name, "scheduler": policy,
+                            "completed": report["completed"]})
+        append_jsonl(os.path.join(outdir, "metrics.jsonl"), {
+            "kind": "scenario", "scenario": scenario.name,
+            "scheduler": policy, "completed": report["completed"],
+            "n_requests": report["n_requests"], "slo": slo, "slo_ok": ok,
+            **({"faults_fired": plan.stats()["total_fired"]}
+               if plan is not None else {}),
+        })
+    return report

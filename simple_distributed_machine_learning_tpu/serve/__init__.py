@@ -49,9 +49,11 @@ from simple_distributed_machine_learning_tpu.serve.request import (  # noqa: F40
 )
 from simple_distributed_machine_learning_tpu.serve.scheduler import (  # noqa: F401
     FCFSScheduler,
+    PriorityScheduler,
 )
 from simple_distributed_machine_learning_tpu.serve.simulator import (  # noqa: F401
     SimConfig,
+    TrafficClass,
     simulate,
 )
 from simple_distributed_machine_learning_tpu.serve.slots import (  # noqa: F401

@@ -43,10 +43,14 @@ import time
 
 import numpy as np
 
+from simple_distributed_machine_learning_tpu.resilience.faults import (
+    maybe_fire,
+)
 from simple_distributed_machine_learning_tpu.serve.metrics import ServeMetrics
 from simple_distributed_machine_learning_tpu.serve.request import (
     ACTIVE,
     DONE,
+    QUEUED,
     Request,
     validate_request,
 )
@@ -131,10 +135,18 @@ class InferenceEngine:
                                               cache_dtype)
             self._decode = make_slot_decode_step(stages, cfg, self.max_len,
                                                  cache_dtype)
-        self.scheduler = scheduler or FCFSScheduler(self.pool)
+        if scheduler is None:
+            scheduler = FCFSScheduler(self.pool)
+        elif not isinstance(scheduler, FCFSScheduler) and callable(scheduler):
+            # a scheduler CLASS/factory: the pool is engine-built, so the
+            # caller cannot construct the instance up front
+            scheduler = scheduler(self.pool)
+        self.scheduler = scheduler
+        self.scheduler.attach(self)
         self.metrics = metrics
         self._clock = clock
         self._next_rid = 0
+        self._tick_count = 0
         self.requests: dict[int, Request] = {}
         # rids admitted but not yet fully prefilled, admission order (the
         # chunked-prefill work queue; always empty in dense layout)
@@ -151,7 +163,8 @@ class InferenceEngine:
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                top_k: int | None = None, top_p: float | None = None,
                eos_id: int | None = None, seed: int | None = None,
-               on_token=None, arrival_time: float | None = None) -> Request:
+               on_token=None, arrival_time: float | None = None,
+               cls: str | None = None, priority: int = 0) -> Request:
         """Enqueue one request; returns its live handle immediately.
 
         ``arrival_time`` backdates ``submit_time`` to when the request
@@ -169,7 +182,8 @@ class InferenceEngine:
         seed = rid if seed is None else seed
         r = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    eos_id=eos_id, seed=seed, on_token=on_token)
+                    eos_id=eos_id, seed=seed, on_token=on_token,
+                    cls=cls, priority=priority)
         # the request's independent key stream — the SAME key a solo
         # make_cached_decoder call would be handed, so streams align
         r.key_data = np.asarray(jax.random.key_data(jax.random.key(seed)))
@@ -193,6 +207,11 @@ class InferenceEngine:
         """
         if not self.busy:
             return 0
+        # fault-injection site (resilience/faults.py): slow-tick stalls the
+        # tick (a degraded device), wedged-device raises DeviceWedged —
+        # no-op without an installed plan
+        maybe_fire("serve.tick", step=self._tick_count)
+        self._tick_count += 1
         if self.kv_layout == "dense":
             emitted = self._admit_dense()
             # occupancy the batched decode actually RUNS at — sampled before
@@ -213,6 +232,43 @@ class InferenceEngine:
                              if self.kv_layout == "paged" else None))
         return emitted
 
+    def preempt(self, rid: int) -> None:
+        """Evict an ACTIVE request from its slot (priority scheduling's
+        room-making — ``PriorityScheduler._make_room``): the slot and its
+        K/V blocks free NOW, the request returns to the queue front with
+        its emitted tokens intact. Re-admission recomputes K/V for
+        ``resume_seq`` (registered prefix blocks usually make that cheap)
+        and reseats on the stored last token with the key stream untouched,
+        so the continued decode is bit-exact vs an unpreempted run.
+
+        Compile-cost note: the dense layout (and a paged engine with
+        ``prefill_chunk=None``) prefills whole sequences, retracing per
+        distinct length — every distinct preemption point is a fresh XLA
+        compile. Preemption-heavy serving should run the default paged
+        layout WITH a ``prefill_chunk``, which bounds prefill shapes to
+        chunk sizes the engine has already compiled."""
+        r = self.requests[rid]
+        if r.state != ACTIVE or r.slot is None:
+            raise ValueError(
+                f"request {rid} is not active (state {r.state!r}, slot "
+                f"{r.slot!r}) — only active requests preempt")
+        try:
+            self._prefilling.remove(rid)   # may be mid-prefill
+        except ValueError:
+            pass
+        self.pool.unbind_seq(r.slot)
+        self.pool.release(r.slot)
+        r.slot = None
+        r.prefill_pos = None
+        r.state = QUEUED
+        r.n_preempted += 1
+        # front of the queue: the victim arrived before anything still
+        # waiting in its own class (pick() is priority-then-FCFS, so this
+        # only orders it within its class)
+        self.scheduler.queue.appendleft(r)
+        if self.metrics is not None:
+            self.metrics.on_preempt(r.cls)
+
     def drain(self, max_ticks: int | None = None) -> list[Request]:
         """Tick until idle (or ``max_ticks``); returns finished requests in
         completion order is not guaranteed — use ``handle.tokens``."""
@@ -232,14 +288,28 @@ class InferenceEngine:
     def _admit_dense(self) -> int:
         emitted = 0
         for r in self.scheduler.admit():
-            t0 = int(r.prompt.shape[0])
+            seq = r.resume_seq       # == r.prompt unless resuming preempted
+            t0 = int(seq.shape[0])
             kc, vc, tok, kd = self._prefill(
                 self.params, self.pool.kc, self.pool.vc,
-                r.prompt[None, :], np.int32(r.slot), r.key_data,
+                seq[None, :], np.int32(r.slot), r.key_data,
                 np.float32(r.temperature),
                 np.int32(r.top_k if r.top_k is not None else _NO_TOP_K),
                 np.float32(r.top_p if r.top_p is not None else _NO_TOP_P))
             self.pool.kc, self.pool.vc = kc, vc
+            if r.tokens:
+                # resuming after preemption: the prefill only rebuilt K/V;
+                # its sampled token AND advanced key are discarded (the key
+                # stream already consumed this split before the preemption)
+                # and decode restarts from the stored newest token. The
+                # TPOT base resets to NOW deliberately: the stall is
+                # preemption wait, tracked by the preemption counters (and
+                # the request-level tpot_s mean), not decode cadence — one
+                # giant sample would distort the per-class cadence
+                # histogram the SLO gate reads
+                self.pool.seat(r.slot, t0, r.tokens[-1])
+                self._last_emit[r.rid] = self._clock()
+                continue
             tok = int(np.asarray(tok))           # host sync: TTFT endpoint
             r.key_data = np.asarray(kd)
             now = self._clock()
@@ -248,7 +318,7 @@ class InferenceEngine:
             r.emit(tok)
             emitted += 1
             if self.metrics is not None:
-                self.metrics.on_first_token(r.ttft_s)
+                self.metrics.on_first_token(r.ttft_s, cls=r.cls)
             reason = r.finished_by(tok)
             if reason is not None:
                 self._finish(r, reason, now)
@@ -289,7 +359,8 @@ class InferenceEngine:
         if not self._prefilling:
             return 0
         r = self.requests[self._prefilling[0]]
-        plen = int(r.prompt.shape[0])
+        seq = r.resume_seq           # == r.prompt unless resuming preempted
+        plen = int(seq.shape[0])
         p0 = r.prefill_pos
         c = (plen - p0 if self.prefill_chunk is None
              else min(self.prefill_chunk, plen - p0))
@@ -297,7 +368,7 @@ class InferenceEngine:
         self._ensure_writable_range(r.slot, p0, c)
         kc, vc, tok, kd = self._chunk_prefill(
             self.params, self.pool.kc, self.pool.vc,
-            r.prompt[None, p0:p0 + c], np.int32(p0),
+            seq[None, p0:p0 + c], np.int32(p0),
             self.pool.device_table(r.slot), r.key_data,
             np.float32(r.temperature),
             np.int32(r.top_k if r.top_k is not None else _NO_TOP_K),
@@ -315,16 +386,26 @@ class InferenceEngine:
             return 0
         self._prefilling.popleft()
         r.prefill_pos = None
+        # publish the sequence's blocks BEFORE any same-tick retirement so
+        # even a 1-token request leaves its prefix reusable (cached blocks
+        # survive end_seq as reclaimable)
+        self.pool.register_prefix(r.slot, seq)
+        if r.tokens:
+            # resuming after preemption: the final chunk only rebuilt K/V;
+            # its sample and advanced key are discarded like a mid-prompt
+            # chunk's (the stream already consumed this split before the
+            # preemption) and decode restarts from the stored newest token.
+            # TPOT base resets to NOW deliberately (see the dense twin):
+            # preemption wait is not decode cadence
+            self.pool.seat(r.slot, plen, r.tokens[-1])
+            self._last_emit[r.rid] = now
+            return 0
         r.key_data = np.asarray(kd)
         r.first_token_time = now
         self._last_emit[r.rid] = now
         r.emit(tok)
         if self.metrics is not None:
-            self.metrics.on_first_token(r.ttft_s)
-        # publish the prompt's blocks BEFORE any same-tick retirement so
-        # even a 1-token request leaves its prefix reusable (cached blocks
-        # survive end_seq as reclaimable)
-        self.pool.register_prefix(r.slot, r.prompt)
+            self.metrics.on_first_token(r.ttft_s, cls=r.cls)
         reason = r.finished_by(tok)
         if reason is not None:
             self._finish(r, reason, now)
@@ -401,7 +482,8 @@ class InferenceEngine:
             r.emit(tok)
             emitted += 1
             if self.metrics is not None:
-                self.metrics.on_token(now - self._last_emit[r.rid])
+                self.metrics.on_token(now - self._last_emit[r.rid],
+                                      cls=r.cls)
             self._last_emit[r.rid] = now
             reason = r.finished_by(tok)
             if reason is not None:
@@ -419,4 +501,4 @@ class InferenceEngine:
             # unused reservation) before the slot frees
             self.scheduler.retire(r, reason)
         if self.metrics is not None:
-            self.metrics.on_complete()
+            self.metrics.on_complete(cls=r.cls)

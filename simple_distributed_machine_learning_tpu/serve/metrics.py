@@ -28,6 +28,18 @@ the engine hands the pool's stats to :meth:`ServeMetrics.on_tick`):
 - ``serve_prefill_chunk_ms`` (histogram) — per-chunk prefill latency: the
   quantity chunked prefill bounds so decode ticks stay steady.
 
+Traffic-class instruments (populated when requests carry ``cls`` — the
+scenario suite's per-class SLO accounting, ``resilience/scenarios.py``):
+
+- ``serve_class_ttft_ms{class=...}`` / ``serve_class_tpot_ms{class=...}``
+  (histograms) — the per-class latency split SLO attainment is computed
+  from (:meth:`ServeMetrics.attainment` via the registry histograms'
+  ``fraction_below``);
+- ``serve_class_completed_total{class=...}`` and
+  ``serve_class_preemptions_total{class=...}`` (counters), plus the global
+  ``serve_preemptions_total`` — how often priority scheduling evicted
+  best-effort traffic to protect an interactive class.
+
 ``emit()`` writes one ``kind: "serve"`` record to ``metrics.jsonl`` and
 refreshes ``metrics.prom`` — the same two artifact formats the training
 telemetry session emits, so one scrape config covers both.
@@ -89,8 +101,20 @@ class ServeMetrics:
                                for k, v in _POOL_COUNTERS.items()}
         self._pool_counter_seen = dict.fromkeys(_POOL_COUNTERS, 0)
         self._paged_seen = False
+        self.preemptions = r.counter("serve_preemptions_total")
+        self._classes: set[str] = set()
         if outdir:
             os.makedirs(outdir, exist_ok=True)
+
+    # -- per-class series (scenario suite) ---------------------------------
+
+    def _class_hist(self, name: str, cls: str):
+        self._classes.add(cls)
+        return self.registry.histogram(name, labels={"class": cls})
+
+    def _class_counter(self, name: str, cls: str):
+        self._classes.add(cls)
+        return self.registry.counter(name, labels={"class": cls})
 
     # -- event hooks (engine-driven) --------------------------------------
 
@@ -99,13 +123,22 @@ class ServeMetrics:
             self._t_first_submit = self._clock()
         self.submitted.inc()
 
-    def on_first_token(self, ttft_s: float) -> None:
+    def on_first_token(self, ttft_s: float, cls: str | None = None) -> None:
         self.ttft_ms.observe(ttft_s * 1e3)
+        if cls is not None:
+            self._class_hist("serve_class_ttft_ms", cls).observe(ttft_s * 1e3)
         self._on_any_token()
 
-    def on_token(self, tpot_s: float) -> None:
+    def on_token(self, tpot_s: float, cls: str | None = None) -> None:
         self.tpot_ms.observe(tpot_s * 1e3)
+        if cls is not None:
+            self._class_hist("serve_class_tpot_ms", cls).observe(tpot_s * 1e3)
         self._on_any_token()
+
+    def on_preempt(self, cls: str | None = None) -> None:
+        self.preemptions.inc()
+        if cls is not None:
+            self._class_counter("serve_class_preemptions_total", cls).inc()
 
     def _on_any_token(self) -> None:
         self.tokens.inc()
@@ -114,8 +147,10 @@ class ServeMetrics:
         if span and span > 0:
             self.tokens_per_sec.set(self.tokens.value / span)
 
-    def on_complete(self) -> None:
+    def on_complete(self, cls: str | None = None) -> None:
         self.completed.inc()
+        if cls is not None:
+            self._class_counter("serve_class_completed_total", cls).inc()
 
     def on_prefill_chunk(self, chunk_ms: float) -> None:
         """One prefill chunk's wall latency (paged engines; the dense
@@ -160,6 +195,42 @@ class ServeMetrics:
             return None
         return self._t_last_token - self._t_first_submit
 
+    def class_summary(self, cls: str) -> dict:
+        """One traffic class's latency/throughput block."""
+        r3 = (lambda v: None if v is None else round(v, 3))
+        ttft = self._class_hist("serve_class_ttft_ms", cls)
+        tpot = self._class_hist("serve_class_tpot_ms", cls)
+        return {
+            "completed": int(
+                self._class_counter("serve_class_completed_total",
+                                    cls).value),
+            "preemptions": int(
+                self._class_counter("serve_class_preemptions_total",
+                                    cls).value),
+            "ttft_ms_p50": r3(ttft.quantile(0.5)),
+            "ttft_ms_p95": r3(ttft.quantile(0.95)),
+            "tpot_ms_p50": r3(tpot.quantile(0.5)),
+            "tpot_ms_p95": r3(tpot.quantile(0.95)),
+        }
+
+    def attainment(self, cls: str, ttft_slo_ms: float | None = None,
+                   tpot_slo_ms: float | None = None) -> dict:
+        """SLO attainment for one class, straight from the registry
+        histograms: the weighted fraction of observations within target
+        (``Histogram.fraction_below``). None targets are skipped; a class
+        with no observations reports None attainment (the scenario runner
+        treats that as failure — silence is not attainment)."""
+        out = dict(self.class_summary(cls))
+        if ttft_slo_ms is not None:
+            out["ttft_slo_ms"] = ttft_slo_ms
+            out["ttft_attainment"] = self._class_hist(
+                "serve_class_ttft_ms", cls).fraction_below(ttft_slo_ms)
+        if tpot_slo_ms is not None:
+            out["tpot_slo_ms"] = tpot_slo_ms
+            out["tpot_attainment"] = self._class_hist(
+                "serve_class_tpot_ms", cls).fraction_below(tpot_slo_ms)
+        return out
+
     def summary(self) -> dict:
         """The serving record block (bench rows and ``emit`` embed it)."""
         r3 = (lambda v: None if v is None else round(v, 3))
@@ -174,6 +245,11 @@ class ServeMetrics:
             "tpot_ms_p95": r3(self.tpot_ms.quantile(0.95)),
             "slot_occupancy_mean": r3(self.occupancy.mean),
         }
+        if self.preemptions.value:
+            out["preemptions"] = int(self.preemptions.value)
+        if self._classes:
+            out["per_class"] = {cls: self.class_summary(cls)
+                                for cls in sorted(self._classes)}
         if self._paged_seen:
             out.update({
                 "blocks_total": int(self.blocks_total.value),

@@ -39,6 +39,13 @@ class Request:
     seed: int = 0
     # streaming: called with (request, token:int) as each token materializes
     on_token: Callable | None = None
+    # traffic class: the scenario suite's per-class SLO label and the
+    # priority schedulers' ordering key (higher boards first and may
+    # preempt lower — serve/scheduler.py::PriorityScheduler). 0 is the
+    # best-effort floor; cls=None requests aggregate into the unlabeled
+    # serving metrics only.
+    cls: str | None = None
+    priority: int = 0
 
     # -- lifecycle (engine-owned) -----------------------------------------
     state: str = QUEUED
@@ -53,6 +60,32 @@ class Request:
     first_token_time: float | None = None
     done_time: float | None = None
     finish_reason: str | None = None    # "eos" | "length"
+    # preemption accounting: a preempted request goes back to QUEUED with
+    # its emitted tokens intact; re-admission recomputes its K/V from
+    # `resume_seq` WITHOUT touching the key stream, so the continued decode
+    # is bit-exact vs never having been preempted (tests/test_scenarios.py)
+    n_preempted: int = 0
+    # scheduler bookkeeping: boarding order (set at admission), used by the
+    # priority scheduler's newest-first victim pick
+    _board_seq: int = -1
+
+    @property
+    def resume_seq(self) -> np.ndarray:
+        """The token sequence (re-)admission must have K/V for: the prompt,
+        plus — after a preemption — every emitted token except the newest
+        (whose K/V the next decode step writes; it rides in ``last_token``).
+        Fresh requests: exactly the prompt."""
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens[:-1], np.int32)])
+
+    @property
+    def resume_max_new(self) -> int:
+        """Remaining new-token budget paired with :attr:`resume_seq` so the
+        pool's worst-case row bound (``len(seq) + budget - 1``) stays exactly
+        ``prompt_len + max_new_tokens - 1`` across preemptions."""
+        return self.max_new_tokens - max(0, len(self.tokens) - 1)
 
     @property
     def ttft_s(self) -> float | None:

@@ -22,8 +22,16 @@ the scheduler invariants sharp enough to pin in tests:
 - the pool's own guards make double-occupancy, double-release and block
   double-alloc/free raise rather than corrupt (``serve/slots.py``).
 
-Smarter policies (shortest-job-first on ``max_new_tokens``, priority
-classes) would subclass and override :meth:`FCFSScheduler.pick`.
+Smarter policies subclass and override :meth:`FCFSScheduler.pick`:
+:class:`PriorityScheduler` (the scenario suite's policy) admits by request
+``priority`` — FCFS within a class — and, when the pool cannot fit a
+higher-priority request, *preempts* best-effort traffic: a lower-priority
+active request is evicted (slot and blocks freed, request re-queued with
+its emitted tokens intact) so the interactive request's prefill boards this
+tick instead of waiting out a batch request's whole decode. The preempted
+request later re-admits and recomputes its K/V from ``resume_seq`` without
+touching its key stream, so its final tokens are bit-exact vs never having
+been preempted (tests/test_scenarios.py).
 """
 
 from __future__ import annotations
@@ -45,6 +53,14 @@ class FCFSScheduler:
     def __init__(self, pool: KVCachePool) -> None:
         self.pool = pool
         self.queue: collections.deque[Request] = collections.deque()
+        # the engine this scheduler serves (attach()): policies that evict
+        # active requests (PriorityScheduler) need it; FCFS never does
+        self._engine = None
+        self._board_count = 0
+
+    def attach(self, engine) -> None:
+        """Called by the engine at construction; see ``_engine``."""
+        self._engine = engine
 
     @property
     def queue_depth(self) -> int:
@@ -78,7 +94,7 @@ class FCFSScheduler:
         admitted = []
         while self.queue:
             r = self.pick()
-            if not self.pool.can_admit(r):
+            if not self.pool.can_admit(r) and not self._make_room(r):
                 self.queue.appendleft(r)
                 break
             r.slot = self.pool.acquire(r.rid)
@@ -87,8 +103,16 @@ class FCFSScheduler:
             # already sees it (a burst cannot over-admit the pool)
             r.prefill_pos = self.pool.bind_seq(r)
             r.state = ACTIVE
+            r._board_seq = self._board_count
+            self._board_count += 1
             admitted.append(r)
         return admitted
+
+    def _make_room(self, request: Request) -> bool:
+        """Policy hook: may the scheduler free capacity for ``request``
+        (e.g. by preempting lower-priority actives)? FCFS never reorders or
+        evicts — a blocked head blocks."""
+        return False
 
     def retire(self, request: Request, reason: str) -> None:
         """Free the request's slot immediately (same tick) so the next
@@ -102,3 +126,61 @@ class FCFSScheduler:
         request.slot = None
         request.state = DONE
         request.finish_reason = reason
+
+
+class PriorityScheduler(FCFSScheduler):
+    """Priority-class admission with prefill preemption of best-effort
+    traffic (the scenario suite's policy; ``resilience/scenarios.py``).
+
+    - :meth:`pick` returns the highest-``priority`` queued request, FCFS
+      within a priority (queue position is arrival order, so the scan's
+      first maximum is the oldest of its class);
+    - when the pool cannot admit the pick, :meth:`_make_room` preempts
+      ACTIVE requests of strictly lower priority — lowest priority first,
+      newest-boarded first within a priority (the least sunk work) — until
+      the pick fits or no eligible victim remains. Victims are re-queued at
+      the FRONT (they arrived before anything still waiting of their class)
+      and later resume by recomputing K/V for their emitted tokens, key
+      stream untouched — output-preserving preempt-and-recompute, so SLO
+      protection is a scheduling change, not a correctness change;
+    - the base class's budget gate still runs on whatever pick returns, so
+      admission can never outspend the pool.
+    """
+
+    def pick(self) -> Request:
+        best_i = 0
+        for i, r in enumerate(self.queue):
+            if r.priority > self.queue[best_i].priority:
+                best_i = i
+        r = self.queue[best_i]
+        del self.queue[best_i]
+        return r
+
+    def _victims_below(self, priority: int) -> list[Request]:
+        victims = [self._engine.requests[self.pool.occupant(s)]
+                   for s in self.pool.active_slots()]
+        return [v for v in victims if v.priority < priority]
+
+    def _make_room(self, request: Request) -> bool:
+        if self._engine is None:
+            return False
+        victims = self._victims_below(request.priority)
+        if not victims:
+            return False
+        # feasibility precheck: eviction discards the victims' computed K/V
+        # irreversibly, so never start unless freeing EVERY eligible victim
+        # would cover the requester's block shortfall — otherwise the loop
+        # would strand the requester unadmitted after throwing away work
+        # (the slot side needs no precheck: any one eviction frees a slot)
+        if self.pool.admit_shortfall(request) > sum(
+                self.pool.freeable_blocks(v.slot) for v in victims):
+            return False
+        while not self.pool.can_admit(request):
+            victims = self._victims_below(request.priority)
+            if not victims:         # pragma: no cover - precheck bound
+                return False
+            # lowest priority first; newest boarding within it
+            victim = max(victims,
+                         key=lambda v: (-v.priority, v._board_seq))
+            self._engine.preempt(victim.rid)
+        return True

@@ -32,12 +32,68 @@ from simple_distributed_machine_learning_tpu.serve.request import DONE
 
 
 @dataclasses.dataclass(frozen=True)
+class TrafficClass:
+    """One tenant/priority class of a multi-class workload.
+
+    ``weight`` is the class's share of arrivals (normalized over the
+    config's classes); ``priority`` feeds the engine's scheduler (higher
+    boards first; ``PriorityScheduler`` may preempt lower to protect it).
+    ``ttft_slo_ms``/``tpot_slo_ms`` are the class's latency targets — the
+    scenario runner computes attainment against them from the telemetry
+    registry (``resilience/scenarios.py``). ``max_new_tokens``/
+    ``prompt_lens`` override the SimConfig-wide workload mix per class
+    (batch tenants decode long, interactive ones short).
+    """
+
+    name: str
+    weight: float = 1.0
+    priority: int = 0
+    ttft_slo_ms: float | None = None
+    tpot_slo_ms: float | None = None
+    max_new_tokens: int | None = None
+    prompt_lens: tuple | None = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("traffic class name must be non-empty")
+        if self.weight <= 0:
+            raise ValueError(f"class {self.name!r}: weight must be > 0, "
+                             f"got {self.weight}")
+
+
+@dataclasses.dataclass(frozen=True)
 class SimConfig:
-    """One traffic run: ``n_requests`` Poisson arrivals at ``rate`` req/s."""
+    """One traffic run: ``n_requests`` arrivals at mean ``rate`` req/s.
+
+    ``arrival`` picks the pattern (all seeded, all open-loop):
+
+    - ``"poisson"`` — homogeneous Poisson (the PR-5 default; byte-identical
+      rng stream to the original single-class simulator, so existing pins
+      hold);
+    - ``"bursty"`` — on/off modulated Poisson: ``burst_factor`` x the mean
+      rate for ``burst_duty`` of every ``period_s`` cycle, a floored trough
+      in between (load spikes — the shape that breaks FCFS TTFT);
+    - ``"diurnal"`` — sinusoidally modulated Poisson with amplitude
+      ``diurnal_amplitude`` over ``period_s`` (the day/night cycle,
+      compressed).
+
+    ``classes`` switches on the multi-tenant workload: each request is
+    assigned a :class:`TrafficClass` by seeded weighted choice and submits
+    with that class's name/priority (per-class SLOs live on the class).
+    Empty = the legacy single-class mix.
+    """
 
     n_requests: int = 16
     rate: float = 8.0
     seed: int = 0
+    # arrival pattern (see class docstring)
+    arrival: str = "poisson"
+    burst_factor: float = 5.0
+    burst_duty: float = 0.25
+    period_s: float = 1.0
+    diurnal_amplitude: float = 0.8
+    # multi-tenant classes; () = single-class legacy workload
+    classes: tuple = ()
     # workload mix: prompt lengths cycle through these buckets (each bucket
     # is one compiled prefill shape), max_new_tokens per request
     prompt_lens: tuple = (4, 8, 12)
@@ -67,6 +123,24 @@ class SimConfig:
         if self.shared_prefix_len < 0:
             raise ValueError(f"shared_prefix_len must be >= 0, got "
                              f"{self.shared_prefix_len}")
+        if self.arrival not in ("poisson", "bursty", "diurnal"):
+            raise ValueError(
+                f"arrival must be poisson|bursty|diurnal, got "
+                f"{self.arrival!r}")
+        if self.burst_factor < 1:
+            raise ValueError(f"burst_factor must be >= 1, got "
+                             f"{self.burst_factor}")
+        if not 0 < self.burst_duty < 1:
+            raise ValueError(f"burst_duty must be in (0, 1), got "
+                             f"{self.burst_duty}")
+        if self.period_s <= 0:
+            raise ValueError(f"period_s must be > 0, got {self.period_s}")
+        if not 0 <= self.diurnal_amplitude < 1:
+            raise ValueError(f"diurnal_amplitude must be in [0, 1), got "
+                             f"{self.diurnal_amplitude}")
+        names = [c.name for c in self.classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate traffic class names: {names}")
 
     @classmethod
     def from_duration(cls, rate: float, duration_s: float, **kw
@@ -79,28 +153,88 @@ class SimConfig:
                    **kw)
 
 
+def _rate_fn(sim: SimConfig):
+    """The arrival-rate profile ``rate(t)`` and its ceiling (for thinning).
+
+    Bursty: ``burst_factor * rate`` inside the first ``burst_duty`` of every
+    ``period_s`` cycle; in between, a trough that keeps the long-run mean at
+    ``rate`` where feasible (floored at 5% of the mean so the process never
+    fully stops). Diurnal: ``rate * (1 + amplitude * sin(2*pi*t/period))``.
+    """
+    rate, period = sim.rate, sim.period_s
+    if sim.arrival == "bursty":
+        duty, factor = sim.burst_duty, sim.burst_factor
+        trough = max(rate * (1 - duty * factor) / (1 - duty), 0.05 * rate)
+        peak = factor * rate
+
+        def fn(t):
+            return peak if (t % period) < duty * period else trough
+        return fn, peak
+    if sim.arrival == "diurnal":
+        amp = sim.diurnal_amplitude
+
+        def fn(t):
+            return rate * (1.0 + amp * np.sin(2.0 * np.pi * t / period))
+        return fn, rate * (1.0 + amp)
+    return (lambda t: rate), rate
+
+
+def _arrival_times(sim: SimConfig, rng) -> np.ndarray:
+    """Seeded arrival timestamps for the configured pattern. The poisson
+    branch draws exactly what the PR-5 simulator drew (one vectorized
+    exponential), so single-class poisson workloads stay byte-identical
+    across this extension; modulated patterns are generated by thinning
+    (an inhomogeneous Poisson process, still fully seeded)."""
+    if sim.arrival == "poisson":
+        return np.cumsum(rng.exponential(1.0 / sim.rate, sim.n_requests))
+    rate_fn, rate_max = _rate_fn(sim)
+    times = np.empty(sim.n_requests)
+    t, i = 0.0, 0
+    while i < sim.n_requests:
+        t += rng.exponential(1.0 / rate_max)
+        if rng.random() * rate_max <= rate_fn(t):
+            times[i] = t
+            i += 1
+    return times
+
+
 def build_workload(sim: SimConfig, vocab: int) -> tuple[np.ndarray, list]:
     """Seeded ``(arrival_times [N], request_specs)``: the whole run's
     traffic, reproducible from ``sim.seed`` alone. Specs are ``submit``
     kwargs; request ``i``'s sampling seed is derived from ``(sim.seed, i)``
-    so two runs of the same config produce the same per-request tokens."""
+    so two runs of the same config produce the same per-request tokens
+    regardless of arrival pattern or class mix."""
     rng = np.random.default_rng(sim.seed)
-    arrivals = np.cumsum(rng.exponential(1.0 / sim.rate, sim.n_requests))
+    arrivals = _arrival_times(sim, rng)
     prefix = rng.integers(0, vocab, sim.shared_prefix_len).astype(np.int32)
+    weights = None
+    if sim.classes:
+        w = np.asarray([c.weight for c in sim.classes], np.float64)
+        weights = w / w.sum()
     specs = []
     for i in range(sim.n_requests):
-        t0 = int(sim.prompt_lens[i % len(sim.prompt_lens)])
+        cls = (sim.classes[int(rng.choice(len(sim.classes), p=weights))]
+               if sim.classes else None)
+        lens = (cls.prompt_lens if cls is not None and cls.prompt_lens
+                else sim.prompt_lens)
+        t0 = int(lens[i % len(lens)])
         prompt = np.concatenate(
             [prefix, rng.integers(0, vocab, t0).astype(np.int32)])
         sampled = rng.random() < sim.sampled_fraction
-        specs.append(dict(
+        spec = dict(
             prompt=prompt,
-            max_new_tokens=sim.max_new_tokens,
+            max_new_tokens=(cls.max_new_tokens
+                            if cls is not None and cls.max_new_tokens
+                            else sim.max_new_tokens),
             temperature=sim.temperature if sampled else 0.0,
             top_k=sim.top_k if sampled else None,
             eos_id=sim.eos_id,
             seed=sim.seed * 100003 + i,
-        ))
+        )
+        if cls is not None:
+            spec["cls"] = cls.name
+            spec["priority"] = cls.priority
+        specs.append(spec)
     return arrivals, specs
 
 
@@ -149,7 +283,9 @@ def simulate(engine: InferenceEngine, sim: SimConfig,
             {"rid": h.rid, "prompt_len": int(h.prompt.shape[0]),
              "n_tokens": len(h.tokens), "finish_reason": h.finish_reason,
              "ttft_s": None if h.ttft_s is None else round(h.ttft_s, 4),
-             "tpot_s": None if h.tpot_s is None else round(h.tpot_s, 5)}
+             "tpot_s": None if h.tpot_s is None else round(h.tpot_s, 5),
+             **({"cls": h.cls, "priority": h.priority,
+                 "n_preempted": h.n_preempted} if h.cls is not None else {})}
             for h in handles],
     }
     if engine.metrics is not None:

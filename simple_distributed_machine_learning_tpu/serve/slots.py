@@ -50,6 +50,19 @@ import math
 import numpy as np
 
 
+def _bind_seq_of(request) -> np.ndarray:
+    """The sequence admission must budget/prefill for: ``resume_seq`` when
+    the request tracks preemption state, its plain prompt otherwise (raw
+    duck-typed requests in tests)."""
+    seq = getattr(request, "resume_seq", None)
+    return request.prompt if seq is None else seq
+
+
+def _bind_budget_of(request) -> int:
+    budget = getattr(request, "resume_max_new", None)
+    return request.max_new_tokens if budget is None else budget
+
+
 class _SlotPoolBase:
     """Slot occupancy accounting shared by both layouts: the free-slot list
     with invariant guards, and the per-slot decode state (position counters
@@ -136,6 +149,18 @@ class _SlotPoolBase:
     def unbind_seq(self, slot: int) -> None:
         """Release the slot's sequence state at retirement (before the slot
         itself frees). Dense layout: nothing to do."""
+
+    # -- preemption feasibility (PriorityScheduler's precheck) --------------
+
+    def admit_shortfall(self, request) -> int:
+        """Sequence-budget units ``request`` is short of admission (beyond
+        a free slot). Dense layout: the row is the whole budget — 0."""
+        return 0
+
+    def freeable_blocks(self, slot: int) -> int:
+        """Budget guaranteed back if ``slot``'s sequence ends now. Dense
+        layout: nothing beyond the slot itself — 0."""
+        return 0
 
 
 class KVCachePool(_SlotPoolBase):
@@ -280,15 +305,38 @@ class PagedKVPool(_SlotPoolBase):
         ``blocks_available`` without consuming reservation — counting them
         as both "shared, free of charge" and "reclaimable headroom" would
         approve a request ``begin_seq`` cannot actually fund."""
-        if not self._free:
-            return False
+        return bool(self._free) and self.admit_shortfall(request) == 0
+
+    def admit_shortfall(self, request) -> int:
+        """Blocks ``request`` is short of admission (0 = the block budget
+        fits; a free slot is checked separately). The PriorityScheduler's
+        preemption precheck compares this against the victims' guaranteed
+        :meth:`freeable_blocks` so eviction never discards work that could
+        not possibly let the requester board."""
         _shared_len, chain = self._probe_cached(request)
         n_shared_full = sum(1 for _, fill in chain if fill == self.block_size)
         n_shared_reclaimable = sum(1 for b, _ in chain if self.ref[b] == 0)
         budget = self.blocks_for(
-            self._rows_needed(int(np.asarray(request.prompt).shape[0]),
-                              request.max_new_tokens)) - n_shared_full
-        return budget <= self.blocks_available - n_shared_reclaimable
+            self._rows_needed(int(np.asarray(_bind_seq_of(request)).shape[0]),
+                              _bind_budget_of(request))) - n_shared_full
+        return max(0, budget - (self.blocks_available - n_shared_reclaimable))
+
+    def freeable_blocks(self, slot: int) -> int:
+        """Blocks GUARANTEED back into availability-for-an-admission if
+        ``slot``'s sequence ends now: its unused reservation plus its
+        solely-referenced UNCACHED table blocks (ref drops to 0, straight
+        to the free list). Shared blocks stay with their referents, and
+        cached (registered-prefix) blocks are deliberately excluded even at
+        ref 1: they land on the reclaimable LRU, where an admission probe
+        that SHARES them re-discounts them as reclaimable chain blocks
+        (``admit_shortfall``'s n_shared_reclaimable) — counting them here
+        would let the preemption precheck approve evictions that cannot
+        actually fund the requester. Conservative: may under-report (a
+        missed preemption), never over-report (work destroyed for
+        nothing)."""
+        return int(self._resv[slot]) + sum(
+            1 for b in self.tables[slot]
+            if self.ref[b] == 1 and not self._cached.get(b))
 
     def begin_seq(self, slot: int, prompt: np.ndarray,
                   max_new_tokens: int) -> int:
@@ -323,8 +371,11 @@ class PagedKVPool(_SlotPoolBase):
         return shared_len
 
     def bind_seq(self, request) -> int | None:
-        return self.begin_seq(request.slot, request.prompt,
-                              request.max_new_tokens)
+        # resume_seq/resume_max_new: identical to prompt/max_new_tokens for
+        # fresh requests; after a preemption they cover the already-emitted
+        # tokens whose K/V re-admission must recompute (serve/request.py)
+        return self.begin_seq(request.slot, _bind_seq_of(request),
+                              _bind_budget_of(request))
 
     def unbind_seq(self, slot: int) -> None:
         self.end_seq(slot)
@@ -427,16 +478,19 @@ class PagedKVPool(_SlotPoolBase):
     # -- prefix registry ---------------------------------------------------
 
     def _probe_cached(self, request) -> tuple[int, list[tuple[int, int]]]:
-        """Probe memoized on the request, keyed by the registry epoch — a
-        blocked head-of-line request is re-probed every tick by
-        ``can_admit``, and without the memo each probe re-hashes up to
-        ``block_size`` prompt prefixes per block. The epoch bumps on every
-        registry mutation, so a stale chain can never be returned."""
+        """Probe memoized on the request, keyed by the registry epoch AND
+        the bind sequence's length — a blocked head-of-line request is
+        re-probed every tick by ``can_admit``, and without the memo each
+        probe re-hashes up to ``block_size`` prompt prefixes per block. The
+        epoch bumps on every registry mutation, and a preemption grows the
+        request's bind sequence, so a stale chain can never be returned."""
+        seq = np.asarray(_bind_seq_of(request))
+        key = (self._registry_epoch, int(seq.shape[0]))
         memo = getattr(request, "_prefix_probe", None)
-        if memo is not None and memo[0] == self._registry_epoch:
+        if memo is not None and memo[0] == key:
             return memo[1], memo[2]
-        shared_len, chain = self._probe_prefix(np.asarray(request.prompt))
-        request._prefix_probe = (self._registry_epoch, shared_len, chain)
+        shared_len, chain = self._probe_prefix(seq)
+        request._prefix_probe = (key, shared_len, chain)
         return shared_len, chain
 
     def _probe_prefix(self, prompt: np.ndarray

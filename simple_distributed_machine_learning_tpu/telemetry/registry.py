@@ -115,6 +115,16 @@ class Histogram:
                 return v
         return pairs[-1][0]
 
+    def fraction_below(self, threshold: float) -> float | None:
+        """Weighted fraction of reservoir observations ``<= threshold`` —
+        the SLO-attainment primitive ("what share of requests met the
+        target?"); None before any observation."""
+        if not self._ring:
+            return None
+        total = sum(w for _, w in self._ring)
+        hit = sum(w for v, w in self._ring if v <= threshold)
+        return hit / total
+
     @property
     def mean(self) -> float | None:
         return self.sum / self.count if self.count else None

@@ -57,8 +57,19 @@ def _gather_state(buf: jax.Array, opt_state: Any, step: int,
     return arrays, meta
 
 
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file failed to load (truncated/corrupt): raised with
+    the offending path instead of a raw zipfile/KeyError traceback, so the
+    operator (or ``resilience.CheckpointStore.latest_valid``) knows which
+    file to discard."""
+
+
 def _write_npz(path: str, arrays: dict, meta: dict) -> None:
     import tempfile
+
+    from simple_distributed_machine_learning_tpu.resilience.faults import (
+        maybe_fire,
+    )
 
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     arrays = dict(arrays)
@@ -73,6 +84,11 @@ def _write_npz(path: str, arrays: dict, meta: dict) -> None:
     os.close(fd)
     try:
         np.savez(tmp, **arrays)
+        # fault-injection site (resilience/faults.py): ckpt-write-crash
+        # truncates the temp and raises HERE — after the bytes, before the
+        # rename — proving the committed checkpoint survives a mid-write
+        # crash (the whole point of write-then-os.replace)
+        maybe_fire("ckpt.write", path=path, tmp=tmp)
         os.replace(tmp, path)  # atomic: old checkpoint intact until whole
     except BaseException:
         try:
@@ -149,6 +165,30 @@ def save_checkpoint_async(path: str, buf: jax.Array, opt_state: Any,
     handle._thread = t
     t.start()
     return handle
+
+
+def _load_npz(path: str) -> tuple[dict, dict]:
+    """Load ``(arrays, meta)`` from a checkpoint ``.npz``, turning every
+    truncation/corruption failure mode into :class:`CheckpointCorruptError`
+    naming the path — a half-written file must produce an actionable error,
+    not a raw ``zipfile.BadZipFile``/``KeyError`` traceback."""
+    import zipfile
+
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["_meta_json"]).decode())
+            arrays = {k: z[k] for k in z.files if k != "_meta_json"}
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, KeyError, EOFError, OSError, ValueError,
+            json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is truncated or corrupt "
+            f"({type(e).__name__}: {e}) — it cannot be restored; delete it "
+            f"and restore an earlier checkpoint "
+            f"(resilience.CheckpointStore.latest_valid skips invalid "
+            f"generations automatically)") from e
+    return arrays, meta
 
 
 def _np_unpack(row: np.ndarray, meta) -> Any:
@@ -270,9 +310,7 @@ def repack_checkpoint(path_in: str, path_out: str, src_pipe, dst_pipe
     """Rewrite a checkpoint written at ``src_pipe``'s topology into
     ``dst_pipe``'s packed layout (params + every buffer-shaped optimizer
     leaf; scalar leaves pass through). Single-process, host-side only."""
-    with np.load(path_in) as z:
-        meta = json.loads(bytes(z["_meta_json"]).decode())
-        arrays = {k: z[k] for k in z.files if k != "_meta_json"}
+    arrays, meta = _load_npz(path_in)
     src_shape = tuple(src_pipe._buf0.shape)
     arrays["params"] = repack_packed_buffer(arrays["params"], src_pipe,
                                             dst_pipe)
@@ -290,10 +328,14 @@ def restore_checkpoint(path: str, pipe=None, opt_treedef_like: Any = None,
     pipeline the checkpoint was WRITTEN with — when its stage count differs
     from ``pipe``'s, params and buffer-shaped optimizer leaves are re-packed
     (see :func:`repack_stage_trees` for the supported model conventions)."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["_meta_json"]).decode())
-        params = z["params"]
-        opt_leaves = [z[f"opt_{i}"] for i in range(meta["n_opt_leaves"])]
+    arrays, meta = _load_npz(path)
+    try:
+        params = arrays["params"]
+        opt_leaves = [arrays[f"opt_{i}"] for i in range(meta["n_opt_leaves"])]
+    except KeyError as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is missing array {e.args[0]!r} — truncated "
+            f"or not a training checkpoint") from e
 
     buf = params
     if pipe is not None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import sys
 
 import jax
 import numpy as np
@@ -27,6 +28,9 @@ from simple_distributed_machine_learning_tpu.data.mnist import (
     prefetch_batches,
 )
 from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+from simple_distributed_machine_learning_tpu.resilience.faults import (
+    maybe_fire,
+)
 from simple_distributed_machine_learning_tpu.train.optimizer import (
     Optimizer,
     sgd,
@@ -181,13 +185,31 @@ class Trainer:
         # collective inside save_checkpoint; only process 0 writes the file
         if self.config.async_checkpoint:
             if self._pending_save is not None:
-                self._pending_save.wait()    # one write in flight at a time
+                self._wait_pending()         # one write in flight at a time
             self._pending_save = save_checkpoint_async(
                 self._ckpt_path(), self.buf, self.opt_state,
                 self._step_count, extra={"epoch": epoch})
         else:
             save_checkpoint(self._ckpt_path(), self.buf, self.opt_state,
                             self._step_count, extra={"epoch": epoch})
+
+    def _wait_pending(self) -> None:
+        """Drain the in-flight async checkpoint write, SURFACING a failed
+        write: ``AsyncSave.wait`` re-raises the writer thread's exception
+        (original type and traceback — the supervisor's recoverability
+        dispatch depends on the type) after a loud diagnostic, instead of
+        letting a dead checkpoint pass silently as training success."""
+        pending, self._pending_save = self._pending_save, None
+        try:
+            pending.wait()
+        except BaseException as e:
+            sys.stderr.write(
+                f"[checkpoint] async write to {self._ckpt_path()} FAILED "
+                f"({type(e).__name__}: {e}) — surfacing the writer "
+                f"thread's error; the previously committed checkpoint is "
+                f"intact\n")
+            sys.stderr.flush()
+            raise
 
     # -- reference console surface (simple_distributed.py:114-117,:130-132) --
 
@@ -251,6 +273,11 @@ class Trainer:
             if (cfg.max_steps_per_epoch is not None
                     and batch_idx >= cfg.max_steps_per_epoch):
                 break
+            # fault-injection site (resilience/faults.py): a scheduled
+            # host-kill raises HostLost here (mid-epoch, between steps —
+            # the supervisor restores from disk), slow-tick stalls the
+            # step; one `is None` check when no plan is installed
+            maybe_fire("train.step", step=self._step_count)
             key = jax.random.fold_in(self._key, self._step_count)
             # ragged final batch: zero-padded, masked out of the loss mean
             # (the reference just trains on the short batch, :108-113; the
@@ -385,6 +412,6 @@ class Trainer:
                 self.telemetry.on_epoch(epoch, pipe=self.pipe, extra=record)
             self._save(epoch)
         if self._pending_save is not None:
-            self._pending_save.wait()
+            self._wait_pending()
         if self.telemetry is not None:
             self.telemetry.close()

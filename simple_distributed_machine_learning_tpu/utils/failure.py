@@ -44,6 +44,14 @@ import sys
 import threading
 import time
 
+# deterministic chaos hook (stdlib-only import — safe in the monitor
+# subprocess): a scheduled frozen-peer fault at the "watchdog.heartbeat"
+# site makes a rank stop heartbeating with its socket open, the frozen-
+# process signature the staleness monitor must catch (resilience/faults.py)
+from simple_distributed_machine_learning_tpu.resilience.faults import (
+    check as _check_fault,
+)
+
 EXIT_PEER_LOST = 13
 _HB = b"h"      # heartbeat byte
 _BYE = b"b"     # clean-shutdown byte
@@ -96,10 +104,6 @@ class HeartbeatWatchdog:
                          f"{self.timeout:.0f}s)\n")
         sys.stderr.flush()
         if self.rank == 0:
-            self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            self._server.bind((self.addr, self.port))
-            self._server.listen(self.world_size)
             self._spawn(self._accept_loop)
             self._spawn(self._staleness_loop)
         else:
@@ -152,8 +156,40 @@ class HeartbeatWatchdog:
         t.start()
         self._threads.append(t)
 
+    def _bind_server(self) -> bool:
+        """Bind + listen with retry: a port still held by a previous run's
+        dying watchdog (or an unrelated process) is retried until
+        ``timeout`` — the port-collision fallback — then reported through
+        ``_fail`` with a clear message instead of an unhandled thread
+        OSError. SO_REUSEADDR already covers plain TIME_WAIT; the retry
+        covers a LIVE holder that exits shortly."""
+        deadline = time.monotonic() + self.timeout
+        last_err: OSError | None = None
+        while not self._stopping:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                srv.bind((self.addr, self.port))
+                srv.listen(self.world_size)
+                self._server = srv
+                return True
+            except OSError as e:
+                srv.close()
+                last_err = e
+                if time.monotonic() > deadline:
+                    break
+                time.sleep(0.2)
+        if not self._stopping:
+            self._fail(
+                f"could not bind heartbeat port {self.addr}:{self.port} "
+                f"within {self.timeout:.0f}s ({last_err}) — is another "
+                f"run's watchdog still holding it? (pass a different "
+                f"--heartbeat-port)")
+        return False
+
     def _accept_loop(self) -> None:
-        assert self._server is not None
+        if not self._bind_server():
+            return
         next_id = 0
         while not self._stopping and next_id < self.world_size - 1:
             try:
@@ -186,10 +222,17 @@ class HeartbeatWatchdog:
                        f"goodbye — killed or crashed)")
 
     def _staleness_loop(self) -> None:
-        deadline_first = time.monotonic() + self.timeout
+        deadline_first = None
         while not self._stopping:
             time.sleep(self.interval)
             now = time.monotonic()
+            if self._server is None:
+                # bind still retrying (_bind_server owns that deadline):
+                # clients cannot have connected yet, so the first-connect
+                # clock starts only once the server is actually listening
+                continue
+            if deadline_first is None:
+                deadline_first = now + self.timeout
             with self._lock:
                 n_connected = len(self._last_seen)
                 stale = [p for p, ts in self._last_seen.items()
@@ -227,7 +270,17 @@ class HeartbeatWatchdog:
         # rank 0 never writes; a recv returning EOF means its socket died.
         # Watch for that in a side thread while the main loop heartbeats.
         self._spawn(lambda: self._watch_master(sock))
+        frozen = False
         while not self._stopping:
+            # injected frozen-peer: stop heartbeating, keep the socket open
+            # (exactly what a GIL-wedged or SIGSTOPped rank looks like from
+            # the outside); rank 0's staleness monitor must trip
+            if frozen or any(f.kind == "frozen-peer" for f in
+                             _check_fault("watchdog.heartbeat",
+                                          rank=self.rank)):
+                frozen = True
+                time.sleep(self.interval)
+                continue
             try:
                 sock.sendall(_HB)
             except OSError:
@@ -347,6 +400,11 @@ def _monitor_main(argv=None) -> None:
     peer loss, exit quietly when the parent stops or disappears."""
     import argparse
     import signal
+
+    from simple_distributed_machine_learning_tpu.resilience.faults import (
+        install_from_env,
+    )
+    install_from_env()      # SDML_CHAOS reaches the monitor subprocess too
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--rank", type=int, required=True)

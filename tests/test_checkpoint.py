@@ -261,6 +261,76 @@ def test_repack_gpt_blocks_embed_head(tmp_path):
                                atol=2e-4)
 
 
+def test_mid_write_crash_leaves_committed_checkpoint_intact(tmp_path):
+    """Atomicity pin: a crash BETWEEN writing the checkpoint bytes and the
+    atomic rename (injected ckpt-write-crash, which also truncates the
+    in-flight temp like a real half-write) must leave the previously
+    committed checkpoint bit-intact and restorable, with no temp litter."""
+    from simple_distributed_machine_learning_tpu.resilience import faults
+
+    key = jax.random.key(0)
+    stages, wd, od = make_mlp_stages(key, [12, 16, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wd, od)
+    opt = sgd(0.1, 0.5)
+    buf = pipe.init_params()
+    state = opt.init(buf)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, buf, state, step=1, extra={"epoch": 1})
+    before = open(path, "rb").read()
+
+    faults.install(faults.FaultPlan.parse("ckpt-write-crash@ckpt.write"))
+    try:
+        import pytest
+        with pytest.raises(faults.CheckpointWriteCrash):
+            save_checkpoint(path, buf, state, step=2, extra={"epoch": 2})
+    finally:
+        faults.uninstall()
+    assert open(path, "rb").read() == before
+    assert not [f for f in os.listdir(str(tmp_path)) if ".tmp." in f]
+    ck = restore_checkpoint(path, pipe=pipe, opt_treedef_like=state)
+    assert ck["step"] == 1 and ck["extra"]["epoch"] == 1
+
+
+def test_restore_rejects_truncated_file_with_clear_error(tmp_path):
+    """A truncated/corrupt checkpoint must raise CheckpointCorruptError
+    NAMING THE PATH — not a raw zipfile.BadZipFile or KeyError traceback."""
+    import pytest
+
+    from simple_distributed_machine_learning_tpu.train.checkpoint import (
+        CheckpointCorruptError,
+    )
+
+    key = jax.random.key(0)
+    stages, wd, od = make_mlp_stages(key, [12, 16, 10], 2)
+    mesh = make_mesh(n_stages=2, n_data=1)
+    pipe = Pipeline(stages, mesh, wd, od)
+    buf = pipe.init_params()
+    state = sgd(0.1, 0.5).init(buf)
+    path = str(tmp_path / "trunc.npz")
+    save_checkpoint(path, buf, state, step=3)
+
+    # mid-write truncation (the torn file a real crash leaves behind)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointCorruptError, match="trunc.npz"):
+        restore_checkpoint(path, pipe=pipe)
+
+    # not-a-zip garbage
+    bad = str(tmp_path / "garbage.npz")
+    with open(bad, "wb") as f:
+        f.write(b"not a zip at all")
+    with pytest.raises(CheckpointCorruptError, match="garbage.npz"):
+        restore_checkpoint(bad)
+
+    # a valid npz that is not a training checkpoint (missing _meta_json)
+    import numpy as _np
+    notckpt = str(tmp_path / "notckpt.npz")
+    _np.savez(notckpt, x=_np.zeros(3))
+    with pytest.raises(CheckpointCorruptError, match="notckpt.npz"):
+        restore_checkpoint(notckpt)
+
+
 def test_repack_rejects_structural_renames():
     """LeNet's 1-stage fused tree is a structural rename of its 2-stage
     split, not a contiguous re-split — must be rejected loudly."""

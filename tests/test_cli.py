@@ -179,3 +179,68 @@ def test_cli_eval_only_from_checkpoint(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "Train Epoch" not in out
     assert re.findall(r"Accuracy: (\d+)/", out)[-1] == acc_trained
+
+
+def test_cli_scenario_slo_gate(tmp_path, capsys):
+    """--scenario: the SLO-gated serving scenario exits 0 with per-class
+    attainment printed and the gateable records in --telemetry-dir; the
+    virtual clock makes the numbers machine-independent."""
+    import json
+    import os
+
+    main(["--rank", "0", "--scenario", "burst-interactive",
+          "--telemetry-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "scenario burst-interactive (priority): 28/28 completed" in out
+    assert "SLO ATTAINED" in out
+    recs = [json.loads(line)
+            for line in open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    scen = [r for r in recs if r.get("kind") == "scenario"][-1]
+    assert scen["slo_ok"] is True
+    assert scen["slo"]["interactive"]["ttft_attainment"] >= 0.9
+
+
+def test_cli_scenario_list_and_unknown(capsys):
+    main(["--rank", "0", "--scenario", "list"])
+    out = capsys.readouterr().out
+    assert "burst-interactive" in out and "multi-tenant" in out
+    with pytest.raises(SystemExit, match="unknown --scenario"):
+        main(["--rank", "0", "--scenario", "nope"])
+
+
+def test_cli_chaos_elastic_restart_end_to_end(tmp_path, capsys):
+    """--chaos: host-kill mid-epoch-2 -> the supervisor restores the
+    epoch-1 checkpoint from the store, repacks 2 stages -> 1, resumes to
+    completion and exits 0 (the CI chaos job's shape)."""
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--mlp-dims", "784,32,10", "--stages", "2", "--epochs", "3",
+          "--max-steps-per-epoch", "4", "--data-root", "/nonexistent",
+          "--checkpoint-dir", str(tmp_path / "store"),
+          "--chaos", "host-kill@train.step=6", "--chaos-stages", "2,1"])
+    out = capsys.readouterr().out
+    assert "restored ckpt-00000004.npz (step 4, written at 2 stages, " \
+           "repacked onto 1); resuming at epoch 2" in out
+    assert ("chaos: completed after 1 restart(s); attempts: "
+            "2st/fault(HostLost) -> 1st/completed") in out
+    import os
+    files = os.listdir(str(tmp_path / "store"))
+    assert "MANIFEST.jsonl" in files
+    assert any(f.startswith("ckpt-") and f.endswith(".npz") for f in files)
+
+
+def test_cli_chaos_validation():
+    with pytest.raises(SystemExit, match="--checkpoint-dir"):
+        main(["--rank", "0", "--model", "mlp", "--chaos",
+              "host-kill@train.step=1"])
+    with pytest.raises(SystemExit, match="mlp or gpt"):
+        main(["--rank", "0", "--model", "lenet", "--chaos",
+              "host-kill@train.step=1", "--checkpoint-dir", "/tmp/x"])
+    with pytest.raises(SystemExit, match="bad --chaos spec"):
+        main(["--rank", "0", "--model", "mlp", "--chaos", "explode@here",
+              "--checkpoint-dir", "/tmp/x"])
+    with pytest.raises(SystemExit, match="--chaos-stages"):
+        main(["--rank", "0", "--model", "mlp", "--chaos",
+              "host-kill@train.step=1", "--checkpoint-dir", "/tmp/x",
+              "--chaos-stages", "two,one"])
+    with pytest.raises(SystemExit, match="--max-steps-per-epoch"):
+        main(["--rank", "0", "--model", "mlp", "--max-steps-per-epoch", "0"])
