@@ -631,7 +631,7 @@ def measure_decode(windows: int = 5, cfg=None, prompt_len: int = 32,
 def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                     slots: int = 8, max_new: int = 24, cfg=None,
                     prompt_lens: tuple = (8, 16, 32), block_size: int = 16,
-                    compare: bool = True) -> list[dict]:
+                    compare: bool = True, lint: bool = False) -> list[dict]:
     """Offered-load sweep of the continuous-batching engine (serve/).
 
     One row per Poisson arrival rate through an ``slots``-slot engine, plus
@@ -679,6 +679,46 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
             f"prompt {max(prompt_lens)} + max_new {max_new} exceeds "
             f"seq_len {cfg.seq_len}")
     stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, n_stages=1)
+    if lint:
+        # --serve --lint: preflight the EXACT serving programs this sweep
+        # is about to time — the paged sweep engines (including the 1-slot
+        # sequential baseline) AND, with compare=True, the paged-vs-dense
+        # comparison engines, whose n_slots/n_blocks/prefill_chunk are
+        # traced shapes and contract bounds, i.e. DIFFERENT compiled
+        # programs — abort before any compile/timing work on ERROR findings
+        from simple_distributed_machine_learning_tpu.analysis.programs import (
+            ServeSpec,
+            lint_serve,
+        )
+        sspecs = [
+            # the sweep rows and the 1-slot sequential baseline (n_slots is
+            # a traced shape: different compiled programs)
+            ServeSpec(cfg, n_slots=slots, kv_layout="paged",
+                      block_size=block_size, prompt_lens=prompt_lens),
+            ServeSpec(cfg, n_slots=1, kv_layout="paged",
+                      block_size=block_size, prompt_lens=prompt_lens)]
+        if compare:
+            geo = _compare_geometries(cfg, slots=slots, max_new=max_new,
+                                      prompt_lens=prompt_lens,
+                                      block_size=block_size)
+            for _label, kw in geo["fixed_mem"]:
+                sspecs.append(ServeSpec(cfg, prompt_lens=prompt_lens, **kw))
+            lp_lens = (min(prompt_lens), geo["long_len"])
+            for _label, kw in geo["longprompt"]:
+                sspecs.append(ServeSpec(cfg, prompt_lens=lp_lens, **kw))
+        seen = []
+        for sspec in sspecs:
+            if sspec in seen:
+                continue
+            seen.append(sspec)
+            rep = lint_serve(stages, sspec)
+            print(rep.format(costs=False))
+            if not rep.ok():
+                raise SystemExit("bench --serve: serve-program preflight "
+                                 "found ERROR findings")
+        print(f"bench --serve: lint preflight clean "
+              f"({len(seen)} deployments"
+              + (", paged + dense" if compare else ", paged") + ")")
 
     def run(rate, n_slots, label):
         engine = InferenceEngine(stages, cfg, n_slots=n_slots,
@@ -720,6 +760,39 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                        "backend": rows[0]["backend"], "rows": rows},
                       f, indent=2)
     return rows
+
+
+def _compare_geometries(cfg, slots: int, max_new: int, prompt_lens: tuple,
+                        block_size: int) -> dict:
+    """Engine-constructor kwargs for the paged-vs-dense comparison rows.
+
+    Shared by ``--serve --lint`` (which must preflight the exact programs
+    the comparison compiles — these geometries differ from the sweep
+    engines in n_slots/n_blocks/prefill_chunk, all traced shapes) and
+    :func:`_measure_paged_vs_dense` (which builds engines from them)."""
+    mem_slots = max(2, slots // 4)          # the dense pool being matched
+    bps = -(-cfg.seq_len // block_size)     # blocks per max_len sequence
+    n_blocks = mem_slots * bps              # same bytes as the dense rows
+    rows_per_req = max(prompt_lens) + max_new - 1
+    blocks_per_req = -(-rows_per_req // block_size)
+    paged_slots = min(32, max(mem_slots + 1, n_blocks // blocks_per_req))
+    n_short = max(2, slots // 2)
+    return {
+        "fixed_mem": (
+            ("gpt_serve_dense_fixed_mem",
+             dict(n_slots=mem_slots, kv_layout="dense")),
+            ("gpt_serve_paged_fixed_mem",
+             dict(n_slots=paged_slots, kv_layout="paged",
+                  block_size=block_size, n_blocks=n_blocks))),
+        "longprompt": (
+            ("gpt_serve_dense_longprompt",
+             dict(n_slots=n_short + 1, kv_layout="dense")),
+            ("gpt_serve_paged_chunked_longprompt",
+             dict(n_slots=n_short + 1, kv_layout="paged",
+                  block_size=block_size, prefill_chunk=block_size))),
+        "long_len": cfg.seq_len - max_new,
+        "n_short": n_short,
+    }
 
 
 def _measure_paged_vs_dense(stages, cfg, slots: int, n_requests: int,
@@ -777,20 +850,12 @@ def _measure_paged_vs_dense(stages, cfg, slots: int, n_requests: int,
 
     # -- 1. fixed-memory concurrency --------------------------------------
     out = []
-    mem_slots = max(2, slots // 4)          # the dense pool being matched
-    bps = -(-cfg.seq_len // block_size)     # blocks per max_len sequence
-    n_blocks = mem_slots * bps              # same bytes as the dense rows
-    rows_per_req = max(prompt_lens) + max_new - 1
-    blocks_per_req = -(-rows_per_req // block_size)
-    paged_slots = min(32, max(mem_slots + 1, n_blocks // blocks_per_req))
+    geo = _compare_geometries(cfg, slots=slots, max_new=max_new,
+                              prompt_lens=prompt_lens, block_size=block_size)
+    paged_slots = geo["fixed_mem"][1][1]["n_slots"]
     burst = [_spec(prompt_lens[i % len(prompt_lens)], i)
              for i in range(max(n_requests, 2 * paged_slots))]
-    for label, kw in (
-            ("gpt_serve_dense_fixed_mem",
-             dict(n_slots=mem_slots, kv_layout="dense")),
-            ("gpt_serve_paged_fixed_mem",
-             dict(n_slots=paged_slots, kv_layout="paged",
-                  block_size=block_size, n_blocks=n_blocks))):
+    for label, kw in geo["fixed_mem"]:
         if "fixed_mem" not in parts:
             break
         engine = InferenceEngine(stages, cfg, **kw)
@@ -809,14 +874,9 @@ def _measure_paged_vs_dense(stages, cfg, slots: int, n_requests: int,
     # -- 2. long-prompt prefill stall -------------------------------------
     # the stress case: a prompt near the sequence budget, so the monolithic
     # prefill tick dwarfs a decode tick
-    long_len = cfg.seq_len - max_new
-    n_short = max(2, slots // 2)
-    for label, kw in (
-            ("gpt_serve_dense_longprompt",
-             dict(n_slots=n_short + 1, kv_layout="dense")),
-            ("gpt_serve_paged_chunked_longprompt",
-             dict(n_slots=n_short + 1, kv_layout="paged",
-                  block_size=block_size, prefill_chunk=block_size))):
+    long_len = geo["long_len"]
+    n_short = geo["n_short"]
+    for label, kw in geo["longprompt"]:
         if "longprompt" not in parts:
             break
         engine = InferenceEngine(stages, cfg, **kw)
@@ -960,7 +1020,8 @@ def main() -> None:
     ap.add_argument("--lint", action="store_true",
                     help="static-analysis preflight (analysis/): lint the "
                          "exact scanned step of every row before timing it "
-                         "and abort on ERROR findings")
+                         "(with --serve, the whole serving-program registry "
+                         "on both KV layouts) and abort on ERROR findings")
     ap.add_argument("--smoke-probe", action="store_true",
                     help=argparse.SUPPRESS)  # the probe SUBPROCESS body
     args = ap.parse_args()
@@ -1058,7 +1119,7 @@ def main() -> None:
     if args.decode and not args.all:
         _run_decode()
     if args.serve:
-        for srow in measure_serving():
+        for srow in measure_serving(lint=args.lint):
             line = {"metric": srow["config"], "n_slots": srow["n_slots"]}
             # sweep rows report throughput+latency; the paged-vs-dense
             # comparison rows report concurrency / tick-latency instead

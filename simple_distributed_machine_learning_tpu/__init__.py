@@ -27,4 +27,15 @@ Subpackages
 
 __version__ = "0.1.0"
 
-from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh  # noqa: F401
+__all__ = ["make_mesh"]
+
+
+def __getattr__(name: str):
+    # Lazy (PEP 562) so jax-free tooling — analysis.hostlint, the watchdog
+    # monitor — can import subpackages without pulling jax through here.
+    if name == "make_mesh":
+        from simple_distributed_machine_learning_tpu.parallel.mesh import (
+            make_mesh,
+        )
+        return make_mesh
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

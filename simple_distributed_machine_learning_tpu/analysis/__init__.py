@@ -22,7 +22,23 @@ Rule families (the catalog table lives in docs/ARCHITECTURE.md):
   permutation endpoints outside the axis, trace-time axis binding errors;
 - ``dtype-drift`` — sub-fp32 cross-device reductions and scan carries that
   accumulate in sub-fp32;
-- ``donation`` — buffers read after being donated to a jitted call.
+- ``donation`` — buffers read after being donated to a jitted call
+  (incl. across program boundaries in a composite serve tick, and one
+  buffer aliased into a call that donates it);
+- ``scatter-bounds`` — dataflow interval analysis (``bounds.py``) proving
+  every gather/scatter/dynamic-slice index stays inside its operand, given
+  declared input contracts (``analysis.spec``) — the serve path's silent
+  K/V-corruption class;
+- ``retrace-explosion`` — decode builders whose trace keys include
+  unbounded runtime values (per-prompt-length retraces) and builders that
+  dropped the ``_DECODE_BUILD_CACHE`` memo (``programs.py``);
+- ``sharded-state`` — gather-before-use / reduce-before-update over
+  declared ZeRO-style shards (``spec(..., vary=('data',))``), the
+  fully-sharded-training groundwork.
+
+``programs.py`` is the whole-program registry (every compiled entry point
+with abstract-arg builders + the HBM-bytes-per-tick cost model);
+``hostlint.py`` is the AST-level twin for the host-side build discipline.
 
 Library API::
 
@@ -33,9 +49,11 @@ Library API::
     if not report.ok():          # any ERROR finding
         raise SystemExit(1)
 
-CLI (the preflight gate ``cli.py --lint`` / ``bench.py --lint`` wrap)::
+CLI (the preflight gates ``cli.py --lint`` / ``bench.py --lint`` wrap)::
 
     python -m simple_distributed_machine_learning_tpu.analysis --dryrun 8
+    python -m simple_distributed_machine_learning_tpu.analysis --serve
+    python -m simple_distributed_machine_learning_tpu.analysis --hostlint
     python -m simple_distributed_machine_learning_tpu.analysis --fixtures
 """
 
@@ -44,26 +62,68 @@ from __future__ import annotations
 from simple_distributed_machine_learning_tpu.analysis.report import (
     CollectiveCost,
     Finding,
+    HBMCost,
     Report,
     Severity,
 )
-from simple_distributed_machine_learning_tpu.analysis.rules import run_rules
-from simple_distributed_machine_learning_tpu.analysis.trace import (
-    abstractify,
-    shape_dtype,
-    trace_to_jaxpr,
-)
 
 __all__ = [
-    "CollectiveCost", "Finding", "Report", "Severity",
-    "abstractify", "analyze", "analyze_jaxpr", "shape_dtype",
+    "ArgSpec", "CollectiveCost", "Finding", "HBMCost", "Report", "Severity",
+    "abstractify", "analyze", "analyze_jaxpr", "shape_dtype", "spec",
 ]
 
+# report.py is pure stdlib; everything else transitively imports jax, so
+# those symbols resolve lazily (PEP 562) — analysis.hostlint stays
+# importable and runnable when jax is absent or wedged (its whole point).
+_LAZY = {
+    "ArgSpec": "bounds", "spec": "bounds",
+    "run_rules": "rules",
+    "abstractify": "trace", "shape_dtype": "trace",
+    "trace_to_jaxpr": "trace",
+}
 
-def analyze_jaxpr(closed_jaxpr, mesh=None, name: str = "") -> Report:
+
+def __getattr__(name: str):
+    import importlib
+
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)
+
+
+def analyze_jaxpr(closed_jaxpr, mesh=None, name: str = "",
+                  arg_ranges=None, arg_vary=None) -> Report:
     """Run the lint suite over an already-traced ``ClosedJaxpr``."""
-    findings, costs = run_rules(closed_jaxpr, active_mesh=mesh)
+    from simple_distributed_machine_learning_tpu.analysis.rules import (
+        run_rules,
+    )
+    findings, costs = run_rules(closed_jaxpr, active_mesh=mesh,
+                                arg_ranges=arg_ranges, arg_vary=arg_vary)
     return Report(name=name, findings=findings, costs=costs)
+
+
+def _unwrap_specs(abstract_args, abstract_kwargs):
+    """Split ``ArgSpec`` annotations out of the args pytree: plain abstract
+    args for tracing, plus flat (range, vary) lists aligned with the traced
+    jaxpr's invars (``jax.make_jaxpr`` flattens ``(args, kwargs)`` the same
+    way)."""
+    import jax
+
+    from simple_distributed_machine_learning_tpu.analysis.bounds import (
+        ArgSpec,
+    )
+    leaves, tree = jax.tree.flatten((abstract_args, abstract_kwargs))
+    ranges = [a.interval if isinstance(a, ArgSpec) else None for a in leaves]
+    vary = [frozenset(a.vary) if isinstance(a, ArgSpec) else frozenset()
+            for a in leaves]
+    plain = [a.sds if isinstance(a, ArgSpec) else a for a in leaves]
+    args, kwargs = jax.tree.unflatten(tree, plain)
+    if not any(r is not None for r in ranges):
+        ranges = None
+    if not any(vary):
+        vary = None
+    return args, kwargs, ranges, vary
 
 
 def analyze(fn, *abstract_args, mesh=None, name: str = "", **abstract_kwargs
@@ -72,6 +132,10 @@ def analyze(fn, *abstract_args, mesh=None, name: str = "", **abstract_kwargs
 
     ``abstract_args`` are ``jax.ShapeDtypeStruct``s (or concrete arrays —
     only shapes/dtypes are read; use :func:`abstractify` on real buffers).
+    Any arg may instead be an :func:`analysis.spec <bounds.spec>` — a
+    ShapeDtypeStruct carrying a declared value range (the scatter-bounds
+    rule's input contract) and/or declared device-varying mesh axes (the
+    sharded-state rule's seed).
     ``mesh`` is the ACTIVE launch mesh: axis existence and sizes of every
     collective are checked against it, catching a step traced for one
     topology and launched on another.
@@ -81,7 +145,12 @@ def analyze(fn, *abstract_args, mesh=None, name: str = "", **abstract_kwargs
     axis the mesh does not carry) is exactly the ``mesh-axis`` defect this
     suite exists to catch, and jax surfaces it at bind time.
     """
+    from simple_distributed_machine_learning_tpu.analysis.trace import (
+        trace_to_jaxpr,
+    )
     name = name or getattr(fn, "__name__", "") or "step"
+    abstract_args, abstract_kwargs, arg_ranges, arg_vary = _unwrap_specs(
+        abstract_args, abstract_kwargs)
     try:
         jaxpr = trace_to_jaxpr(fn, *abstract_args, **abstract_kwargs)
     except Exception as e:  # noqa: BLE001 - any trace error becomes a finding
@@ -105,4 +174,5 @@ def analyze(fn, *abstract_args, mesh=None, name: str = "", **abstract_kwargs
             rule=rule, severity=Severity.ERROR,
             message=f"tracing failed: {type(e).__name__}: {first}",
             where=name, hint=hint)])
-    return analyze_jaxpr(jaxpr, mesh=mesh, name=name)
+    return analyze_jaxpr(jaxpr, mesh=mesh, name=name,
+                         arg_ranges=arg_ranges, arg_vary=arg_vary)

@@ -48,6 +48,16 @@ def main(argv=None) -> int:
     p.add_argument("--dryrun", type=int, default=None, metavar="N",
                    help="analyze the steps dryrun_multichip(N) executes on "
                         "an N-virtual-device mesh")
+    p.add_argument("--serve", action="store_true",
+                   help="lint the serving-program registry (cached decoder "
+                        "+ slot/paged prefill, decode, CoW copy and the "
+                        "composite tick) over the paged layout at two "
+                        "block/chunk shapes plus the dense layout")
+    p.add_argument("--hostlint", action="store_true",
+                   help="host-side AST lint: decode builders memoized "
+                        "through _DECODE_BUILD_CACHE, no bypass call "
+                        "sites in serve/ or tests/, no raw jax.jit in "
+                        "serve/ (pure ast, no tracing)")
     p.add_argument("--fixture", default=None, metavar="NAME",
                    help="run one seeded fixture (see --list)")
     p.add_argument("--fixtures", action="store_true",
@@ -61,41 +71,82 @@ def main(argv=None) -> int:
                    help="print the bytes-over-ICI cost table per step")
     args = p.parse_args(argv)
 
-    from simple_distributed_machine_learning_tpu.analysis.fixtures import (
-        FIXTURES,
-        self_test,
-    )
-
     if args.list:
+        from simple_distributed_machine_learning_tpu.analysis.fixtures import (
+            FIXTURES,
+        )
         print("rule families: ppermute-deadlock unreduced-gradient "
-              "mesh-axis dtype-drift donation")
+              "mesh-axis dtype-drift donation scatter-bounds "
+              "retrace-explosion sharded-state hostlint")
         print("fixtures:")
         for fx in FIXTURES.values():
             kind = "defect" if fx.defect else "clean"
             print(f"  {fx.name:<24} [{kind:>6}] {fx.description}")
         return 0
 
+    if not (args.hostlint or args.serve or args.fixtures
+            or args.fixture is not None or args.dryrun is not None):
+        p.error("nothing to do: pass --dryrun N, --serve, --hostlint, "
+                "--fixture NAME, --fixtures or --list")
+    if args.dryrun is not None and args.dryrun < 1:
+        p.error(f"--dryrun needs a positive device count, got "
+                f"{args.dryrun}")
+
+    # Modes compose: every requested mode runs and the exit code ANDs the
+    # results (a combined `--serve --hostlint` must not silently drop one
+    # gate).  Bootstrap once, sized for the most demanding requested mode —
+    # --hostlint alone stays jax-free (pure ast; pinned by a purge-and-block
+    # subprocess test).
+    need = max(1 if args.serve else 0,
+               8 if (args.fixtures or args.fixture is not None) else 0,
+               args.dryrun or 0)
+    if need:
+        _bootstrap_devices(need)
+    ok = True
+
+    if args.hostlint:
+        from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+            lint_repo,
+        )
+        report = lint_repo()
+        print(report.format(costs=False))
+        host_ok = report.ok(args.fail_on or "error")
+        print(f"analysis --hostlint: {'clean' if host_ok else 'FLAGGED'}")
+        ok &= host_ok
+
+    if args.serve:
+        from simple_distributed_machine_learning_tpu.analysis.programs import (
+            default_registry_reports,
+        )
+        reports = default_registry_reports()
+        for r in reports:
+            print(r.format(costs=args.costs))
+        fail_on = args.fail_on or "error"
+        serve_ok = all(r.ok(fail_on) for r in reports)
+        print(f"analysis --serve: {len(reports)} layouts "
+              f"{'clean' if serve_ok else 'FLAGGED'}")
+        ok &= serve_ok
+
     if args.fixtures:
-        _bootstrap_devices(8)
-        ok, text = self_test()
+        from simple_distributed_machine_learning_tpu.analysis.fixtures import (
+            self_test,
+        )
+        fx_ok, text = self_test()
         print(text)
-        print(f"fixture self-test: {'OK' if ok else 'FAILED'}")
-        return 0 if ok else 1
+        print(f"fixture self-test: {'OK' if fx_ok else 'FAILED'}")
+        ok &= fx_ok
 
     if args.fixture is not None:
+        from simple_distributed_machine_learning_tpu.analysis.fixtures import (
+            FIXTURES,
+        )
         if args.fixture not in FIXTURES:
             p.error(f"unknown fixture {args.fixture!r} (see --list)")
-        _bootstrap_devices(8)
         report = FIXTURES[args.fixture].build()
         print(report.format(costs=args.costs))
-        fail_on = args.fail_on or "warning"
-        return 0 if report.ok(fail_on) else 1
+        ok &= report.ok(args.fail_on or "warning")
 
     if args.dryrun is not None:
-        if args.dryrun < 1:
-            p.error(f"--dryrun needs a positive device count, got "
-                    f"{args.dryrun}")
-        _bootstrap_devices(args.dryrun)
         from simple_distributed_machine_learning_tpu.analysis.preflight import (
             all_ok,
             dryrun_reports,
@@ -104,14 +155,12 @@ def main(argv=None) -> int:
         for r in reports:
             print(r.format(costs=args.costs))
         fail_on = args.fail_on or "error"
-        ok = all_ok(reports, fail_on)
+        dry_ok = all_ok(reports, fail_on)
         print(f"analysis --dryrun {args.dryrun}: "
-              f"{len(reports)} steps {'clean' if ok else 'FLAGGED'}")
-        return 0 if ok else 1
+              f"{len(reports)} steps {'clean' if dry_ok else 'FLAGGED'}")
+        ok &= dry_ok
 
-    p.error("nothing to do: pass --dryrun N, --fixture NAME, --fixtures "
-            "or --list")
-    return 2
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -195,6 +195,173 @@ def read_after_donate() -> Report:
     return analyze(two_phase, b, g, name="fixture:read_after_donate")
 
 
+# -- scatter-bounds: a block-table index past the pool ---------------------
+
+def _tiny_serve():
+    """One tiny GPT build + paged geometry shared by the serve fixtures."""
+    import jax
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    cfg = GPTConfig(vocab=16, seq_len=16, d_model=8, n_heads=2, n_layers=1)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, 1)
+    return cfg, stages
+
+
+def oob_block_table() -> Report:
+    """The paged decode step handed a block-table contract that can reach
+    one past the pool (what an engine WITHOUT slots.py's invariant-guarded
+    tables could feed it): the K/V scatter provably lands outside
+    ``n_blocks + 1`` — another request's blocks, silently."""
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.analysis import spec
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        make_paged_decode_step,
+    )
+    cfg, stages = _tiny_serve()
+    S, ml, bs = 2, 12, 4
+    NB, n_blocks = 3, 6
+    step = make_paged_decode_step(stages, cfg, ml, bs)
+    params = [jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), s.params)
+        for s in stages]
+    kc = jax.ShapeDtypeStruct((1, n_blocks + 1, 2, bs, 4), np.float32)
+    return analyze(
+        step, params, kc, kc,
+        spec((S,), np.int32, 0, cfg.vocab - 1),
+        spec((S,), np.int32, 0, ml - 1),
+        # BUG: entries may reach n_blocks + 1 — one past the last block
+        spec((S, NB), np.int32, 0, n_blocks + 1),
+        jax.ShapeDtypeStruct((S, 2), np.uint32),
+        jax.ShapeDtypeStruct((S,), np.float32),
+        spec((S,), np.int32, 0, cfg.vocab),
+        jax.ShapeDtypeStruct((S,), np.float32),
+        name="fixture:oob_block_table")
+
+
+# -- donation v2: a CoW copy reading buffers the prefill donated -----------
+
+def _cow_tick_report(threaded: bool, name: str) -> Report:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.analysis import spec
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        make_paged_block_copy,
+        make_paged_prefill_chunk,
+    )
+    cfg, stages = _tiny_serve()
+    ml, bs, n_blocks = 12, 4, 6
+    chunk = make_paged_prefill_chunk(stages, cfg, ml, bs)
+    copy = make_paged_block_copy()
+
+    def tick(params, kc, vc, tokens, p0, table, kd, t, k_, p_):
+        kc2, vc2, tok, _kd2 = chunk(params, kc, vc, tokens, p0, table, kd,
+                                    t, k_, p_)
+        if threaded:
+            kc3, vc3 = copy(kc2, vc2, jnp.int32(2), jnp.int32(1))
+        else:
+            # BUG: the copy reads the PRE-PREFILL pool buffers — the chunk
+            # call already donated them, so their pages may back kc2/vc2
+            # by now; this is the cross-program read-after-donate
+            kc3, vc3 = copy(kc, vc, jnp.int32(2), jnp.int32(1))
+        return kc3, vc3, tok
+
+    params = [jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), s.params)
+        for s in stages]
+    kc = jax.ShapeDtypeStruct((1, n_blocks + 1, 2, bs, 4), np.float32)
+    return analyze(
+        tick, params, kc, kc,
+        spec((1, 3), np.int32, 0, cfg.vocab - 1),
+        spec((), np.int32, 0, ml - 4),
+        spec((3,), np.int32, 0, n_blocks),
+        jax.ShapeDtypeStruct((2,), np.uint32),
+        jax.ShapeDtypeStruct((), np.float32),
+        spec((), np.int32, 0, cfg.vocab),
+        jax.ShapeDtypeStruct((), np.float32),
+        name=name)
+
+
+def cow_read_after_donate() -> Report:
+    return _cow_tick_report(False, "fixture:cow_read_after_donate")
+
+
+def clean_cow_tick() -> Report:
+    return _cow_tick_report(True, "fixture:clean_cow_tick")
+
+
+# -- retrace-explosion: a builder that forgets the build cache -------------
+
+def unmemoized_retrace() -> Report:
+    """A decode builder that reconstructs its jitted program on every call
+    instead of routing through ``_DECODE_BUILD_CACHE`` — each engine/test
+    would re-trace and re-compile an identical program."""
+    import jax
+
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        check_builder_memo,
+    )
+
+    def bad_make_decode():
+        @jax.jit
+        def decode(tok):
+            return tok + 1
+        return decode
+
+    return Report(name="fixture:unmemoized_retrace",
+                  findings=check_builder_memo("bad_make_decode",
+                                              bad_make_decode))
+
+
+# -- sharded-state: a ZeRO shard consumed without its gather ---------------
+
+def _zero1_report(reduced: bool, name: str) -> Report:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from simple_distributed_machine_learning_tpu.analysis import spec
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        shard_map,
+    )
+
+    mesh = _mesh(4)
+
+    def step(w, m, g):
+        # ZeRO-style: m is each device's OWN opt-state shard carried in a
+        # replicated-shape buffer (the check_rep=False idiom — no in_spec
+        # can express it, which is what analysis.spec(vary=...) declares)
+        m2 = 0.9 * m + g
+        if reduced:
+            m2 = lax.pmean(m2, "data")   # gather/reduce before the update
+        # else BUG: each device updates the replicated params with ITS
+        # shard's momentum — params silently diverge across the axis
+        return w - 0.1 * m2, m2
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=(P(), P(), P()),
+                           out_specs=(P(), P()), check_vma=False))
+    w = jax.ShapeDtypeStruct((16, 4), jnp.float32)
+    g = jax.ShapeDtypeStruct((16, 4), jnp.float32)
+    m = spec((16, 4), np.float32, vary=("data",))
+    return analyze(fn, w, m, g, mesh=mesh, name=name)
+
+
+def dropped_gather_before_use() -> Report:
+    return _zero1_report(False, "fixture:dropped_gather_before_use")
+
+
+def clean_gather_before_use() -> Report:
+    return _zero1_report(True, "fixture:clean_gather_before_use")
+
+
 # -- clean twin: a full pipeline train step must produce zero findings -----
 
 def clean_pipeline_step() -> Report:
@@ -241,9 +408,27 @@ FIXTURES: dict[str, Fixture] = {f.name: f for f in [
     Fixture("read_after_donate", "donation", True,
             "buffer read after being donated to a jitted update",
             read_after_donate),
+    Fixture("oob_block_table", "scatter-bounds", True,
+            "paged decode with a block-table contract one past the pool",
+            oob_block_table),
+    Fixture("cow_read_after_donate", "donation", True,
+            "CoW block copy reading buffers the prefill chunk donated",
+            cow_read_after_donate),
+    Fixture("unmemoized_retrace", "retrace-explosion", True,
+            "decode builder rebuilding its program outside the memo",
+            unmemoized_retrace),
+    Fixture("dropped_gather_before_use", "sharded-state", True,
+            "ZeRO opt-state shard consumed without gather/reduce",
+            dropped_gather_before_use),
     Fixture("clean_grad_sync", "", False,
             "the dropped_grad_sync fixture with the pmean restored",
             clean_grad_sync),
+    Fixture("clean_cow_tick", "", False,
+            "the CoW tick with donated buffers threaded correctly",
+            clean_cow_tick),
+    Fixture("clean_gather_before_use", "", False,
+            "the ZeRO update with the reduce restored (must be clean)",
+            clean_gather_before_use),
     Fixture("clean_pipeline_step", "", False,
             "a 2-stage dp=2 GPipe train step (must be clean)",
             clean_pipeline_step),

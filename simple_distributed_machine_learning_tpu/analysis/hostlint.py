@@ -1,0 +1,209 @@
+"""Host-side AST lint: the ``_DECODE_BUILD_CACHE`` discipline.
+
+The jaxpr rules see compiled programs; this pass sees the PYTHON that
+builds them. The discipline (models/gpt.py, PR 6): every decode-path
+builder is memoized on its static config in ``_DECODE_BUILD_CACHE``, so a
+fleet of engines (and a test suite full of them) shares one traced +
+compiled program per config. Three ways the discipline rots, all cheap to
+catch with ``ast`` and expensive to catch in production:
+
+- ``hostlint.unmemoized-builder`` — a decode builder in ``models/gpt.py``
+  whose body no longer routes through ``_memo_build`` (a refactor dropped
+  the memo; every engine recompiles);
+- ``hostlint.builder-bypass`` — a call site anywhere outside
+  ``models/gpt.py`` invoking a private ``_build_*`` helper directly,
+  skipping the memo the public ``make_*`` wraps around it;
+- ``hostlint.cache-poke`` — code outside ``models/gpt.py`` touching
+  ``_DECODE_BUILD_CACHE`` itself (clearing or seeding it from a distance);
+- ``hostlint.raw-jit-in-serve`` — a ``jax.jit`` created inside ``serve/``:
+  the serving layer's contract is that every compiled program comes from
+  the memoized gpt builders, so a stray jit there is an unmemoized program
+  by construction.
+
+Pure ``ast`` — no jax import, so the CI lint job runs it in milliseconds:
+``python -m simple_distributed_machine_learning_tpu.analysis --hostlint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from simple_distributed_machine_learning_tpu.analysis.report import (
+    Finding,
+    Report,
+    Severity,
+)
+
+# The memoized decode-path builders (mirrors models.gpt.DECODE_BUILDERS —
+# tests/test_analysis_serve.py pins the two lists equal so this cannot
+# silently drift from the real module).
+DECODE_BUILDER_NAMES = (
+    "make_cached_decoder",
+    "make_slot_prefill",
+    "make_slot_decode_step",
+    "make_paged_prefill_chunk",
+    "make_paged_decode_step",
+    "make_paged_block_copy",
+)
+
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(_PKG)
+GPT_PATH = os.path.join(_PKG, "models", "gpt.py")
+
+
+def _calls_in(node) -> list:
+    return [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _jit_bindings(tree) -> tuple[set, set]:
+    """Names a module binds to jax itself and to jit-like callables, so
+    every spelling is caught: ``jax.jit``, ``import jax as j; j.jit``,
+    ``from jax import jit [as q]``, ``from jax.experimental.pjit import
+    pjit``."""
+    jax_aliases, jit_names = {"jax"}, set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    jax_aliases.add(a.asname or "jax")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name in ("jit", "pjit"):
+                        jit_names.add(a.asname or a.name)
+            elif node.module and node.module.startswith("jax."):
+                for a in node.names:
+                    if a.name == "pjit":
+                        jit_names.add(a.asname or "pjit")
+    return jax_aliases, jit_names
+
+
+def _is_jax_jit(node, jax_aliases: set, jit_names: set) -> bool:
+    """A jit reference in any spelling (covers ``jax.jit(...)``,
+    ``@jax.jit``, ``functools.partial(jax.jit, ...)`` operands, and the
+    aliased forms ``_jit_bindings`` resolves)."""
+    if (isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit")
+            and isinstance(node.value, ast.Name)
+            and node.value.id in jax_aliases):
+        return True
+    return isinstance(node, ast.Name) and node.id in jit_names
+
+
+def _where(path: str, node, repo: str = _REPO) -> str:
+    rel = os.path.relpath(path, repo)
+    return f"{rel}:{getattr(node, 'lineno', '?')}"
+
+
+def lint_builder_definitions(gpt_path: str = GPT_PATH) -> list[Finding]:
+    """Every decode builder's definition must route through the memo."""
+    with open(gpt_path) as f:
+        tree = ast.parse(f.read(), filename=gpt_path)
+    findings: list[Finding] = []
+    defs = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+    for name in DECODE_BUILDER_NAMES:
+        fn = defs.get(name)
+        if fn is None:
+            findings.append(Finding(
+                rule="hostlint.unmemoized-builder", severity=Severity.ERROR,
+                message=f"decode builder '{name}' not found in "
+                        f"{os.path.basename(gpt_path)} — the hostlint "
+                        f"builder list is stale or the builder was removed",
+                where=_where(gpt_path, tree),
+                hint="update DECODE_BUILDER_NAMES alongside the builder"))
+            continue
+        if not any(_call_name(c) == "_memo_build" for c in _calls_in(fn)):
+            findings.append(Finding(
+                rule="hostlint.unmemoized-builder", severity=Severity.ERROR,
+                message=(f"decode builder '{name}' no longer routes its "
+                         f"build through _memo_build — every engine and "
+                         f"test constructing it re-traces and re-compiles "
+                         f"an identical program"),
+                where=_where(gpt_path, fn),
+                hint="wrap the build in _memo_build(key, build) keyed on "
+                     "the static config (see the sibling builders)"))
+    return findings
+
+
+def _lint_call_sites(path: str, allow_jit: bool,
+                     repo: str = _REPO) -> list[Finding]:
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: list[Finding] = []
+    jax_aliases, jit_names = _jit_bindings(tree)
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.Name, ast.Attribute))
+                and (node.id if isinstance(node, ast.Name) else node.attr)
+                == "_DECODE_BUILD_CACHE"):
+            findings.append(Finding(
+                rule="hostlint.cache-poke", severity=Severity.ERROR,
+                message="_DECODE_BUILD_CACHE touched outside models/gpt.py "
+                        "— the memo's invariants (keying, shared "
+                        "executables) belong to its owner",
+                where=_where(path, node, repo),
+                hint="go through the public make_* builders"))
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name.startswith("_build_") and any(
+                    name == "_build" + pub[len("make"):]
+                    for pub in DECODE_BUILDER_NAMES):
+                findings.append(Finding(
+                    rule="hostlint.builder-bypass", severity=Severity.ERROR,
+                    message=(f"direct call to private builder '{name}' "
+                             f"skips the _DECODE_BUILD_CACHE memo — this "
+                             f"call site compiles its own copy of the "
+                             f"program"),
+                    where=_where(path, node, repo),
+                    hint=f"call the public "
+                         f"make{name[len('_build'):]} instead"))
+        if not allow_jit and _is_jax_jit(node, jax_aliases, jit_names):
+            findings.append(Finding(
+                rule="hostlint.raw-jit-in-serve", severity=Severity.ERROR,
+                message="jax.jit created inside serve/ — serving programs "
+                        "must come from the memoized models/gpt.py "
+                        "builders, or every engine compiles its own",
+                where=_where(path, node, repo),
+                hint="add (or extend) a memoized make_* builder in "
+                     "models/gpt.py and call that"))
+    return findings
+
+
+def lint_repo(repo: str = _REPO) -> Report:
+    """The whole hostlint suite: builder definitions in models/gpt.py;
+    cache-poke and builder-bypass EVERYWHERE outside the cache's owner —
+    the whole package, repo-root scripts (bench.py) and tests/ — because
+    "code outside models/gpt.py touching _DECODE_BUILD_CACHE" is the
+    documented rule, and a poke from cli.py or bench.py rots the memo
+    just as surely as one from serve/; raw-jit additionally in serve/
+    (every other layer creates jits legitimately)."""
+    pkg = os.path.join(repo,
+                       "simple_distributed_machine_learning_tpu")
+    gpt = os.path.abspath(os.path.join(pkg, "models", "gpt.py"))
+    findings = lint_builder_definitions(gpt)
+    serve_dir = os.path.abspath(os.path.join(pkg, "serve")) + os.sep
+    paths: list[str] = []
+    for d in (pkg, os.path.join(repo, "tests")):
+        if not os.path.isdir(d):
+            continue
+        for root, _dirs, files in sorted(os.walk(d)):
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    paths.append(os.path.join(root, fname))
+    paths.extend(os.path.join(repo, f) for f in sorted(os.listdir(repo))
+                 if f.endswith(".py"))
+    for path in paths:
+        ap = os.path.abspath(path)
+        if ap == gpt:
+            continue
+        findings.extend(_lint_call_sites(
+            path, allow_jit=not ap.startswith(serve_dir), repo=repo))
+    return Report(name="hostlint", findings=findings)

@@ -1,0 +1,475 @@
+"""The compiled-program registry: one call lints everything a launch runs.
+
+PR 3's preflight covered the train/eval step; since the serving subsystem
+landed, the riskiest compiled code is the DECODE path — five programs
+(``models/gpt.py``: ``make_slot_prefill``/``make_slot_decode_step`` for the
+dense layout, ``make_paged_prefill_chunk``/``make_paged_decode_step``/
+``make_paged_block_copy`` for the paged one, plus ``make_cached_decoder``,
+the solo-parity anchor) whose failure modes are silent: an out-of-range
+block-table index scatters K/V into another request's blocks, a CoW copy
+reads a buffer the prefill already donated, a per-prompt-length retrace
+explodes the trace cache under real traffic. This module enumerates those
+entry points with ABSTRACT-ARG BUILDERS — each argument carries the value
+contract the host side (``serve/slots.py``) maintains, declared via
+``analysis.spec`` — so ``lint_serve`` traces and lints the exact programs a
+serve tick will execute, plus a composite tick that threads donated buffers
+across program boundaries the way ``serve/engine.py`` does.
+
+What runs per program:
+
+- the full PR-3 rule walk (donation incl. double-donation, mesh-axis,
+  dtype-drift — serving is single-device, so collective families are
+  vacuous here but the walk still guards regressions);
+- the ``scatter-bounds`` interval pass (``analysis/bounds.py``) against the
+  declared contracts — block-table gathers proven within ``n_blocks + 1``,
+  position counters within ``block_size``/``max_len``: the trash-page and
+  trailing-zero disciplines ``serve/slots.py`` argues in prose,
+  machine-checked against the compiled artifact;
+- the ``retrace-explosion`` policy checks (builders memoized through
+  ``_DECODE_BUILD_CACHE``; trace keys with unbounded runtime shapes
+  flagged unless the deployment bounds them — prompt-length buckets or a
+  ``prefill_chunk``);
+- the HBM-bytes-per-tick cost model (:class:`~.report.HBMCost`): the
+  serving twin of the ICI table — K/V bytes gathered/scattered per decode
+  tick as a function of block size and slot count, plus
+  :func:`predict_kv_bytes_resident`, cross-checked against the pool's
+  ``serve_kv_bytes_resident`` gauge in tests.
+
+Entry points::
+
+    spec = ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=16,
+                     prefill_chunk=8, prompt_lens=(4, 8, 12))
+    report = lint_serve(stages, spec)         # one Report, all programs
+    report = lint_engine(engine)              # a live engine's exact knobs
+
+``SDML_LINT_INJECT=<tag>`` (environment) appends one seeded ERROR finding
+to every ``lint_serve`` report — the resilience-style drill that proves the
+``--lint`` gates actually exit nonzero (CI and tests use it; never set it
+in a real launch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Callable
+
+from simple_distributed_machine_learning_tpu.analysis import (
+    Report,
+    abstractify,
+    analyze,
+    spec,
+)
+from simple_distributed_machine_learning_tpu.analysis.report import (
+    Finding,
+    HBMCost,
+    Severity,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeSpec:
+    """Static description of one serving deployment — what the registry
+    needs to rebuild the exact compiled programs and their contracts.
+
+    ``prompt_lens`` declares the deployment's prompt-length buckets (the
+    simulator's ``GPT_SERVE_PROMPTS``, a real frontend's bucketing): the
+    retrace-explosion rule treats a prompt-shaped trace key as bounded iff
+    buckets are declared or chunked prefill bounds the shapes."""
+    cfg: Any
+    n_slots: int = 4
+    max_len: int | None = None          # None -> cfg.seq_len
+    kv_layout: str = "paged"
+    block_size: int = 16
+    n_blocks: int | None = None         # None -> dense-equivalent capacity
+    prefill_chunk: int | None = None
+    cache_dtype: Any = None
+    prompt_lens: tuple | None = None
+
+    @property
+    def ml(self) -> int:
+        return int(self.max_len if self.max_len is not None
+                   else self.cfg.seq_len)
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return math.ceil(self.ml / self.block_size)
+
+    @property
+    def nb(self) -> int:
+        """Resolved pool capacity in blocks (the engine's default rule)."""
+        if self.n_blocks is not None:
+            return int(self.n_blocks)
+        return self.n_slots * self.blocks_per_seq
+
+    @property
+    def resolved_chunk(self) -> int:
+        """The prefill-chunk length the compiled program actually traces
+        for this deployment: the declared chunk, else the largest prompt
+        bucket (whole-remaining-prompt chunks compile per prompt shape),
+        else 8; clamped to [1, ml-1]. The HBM model MUST use this same
+        rule — a table row for a chunk the registry never built would
+        mis-state the linted program's bytes."""
+        c = self.prefill_chunk
+        if c is None:
+            c = int(max(self.prompt_lens)) if self.prompt_lens else 8
+        return max(1, min(int(c), self.ml - 1))
+
+
+@dataclasses.dataclass(frozen=True)
+class Program:
+    """One registry entry: a built (memoized) callable plus the abstract
+    args — with declared contracts — that one serve tick would feed it."""
+    name: str
+    fn: Callable
+    args: tuple
+
+
+def check_builder_memo(name: str, build: Callable[[], Any]) -> list[Finding]:
+    """The ``_DECODE_BUILD_CACHE`` contract, machine-checked: calling a
+    decode-path builder twice with identical static config must return the
+    SAME callable (and therefore the same compiled executables). A builder
+    that returns fresh objects recompiles per engine/test instance — the
+    retrace-explosion failure mode at the build level."""
+    first, second = build(), build()
+    if first is second:
+        return []
+    return [Finding(
+        rule="retrace-explosion.unmemoized-builder", severity=Severity.ERROR,
+        message=(f"builder '{name}' returned a DIFFERENT callable for an "
+                 f"identical static config — every engine (and every test) "
+                 f"constructing it pays a fresh trace + XLA compile"),
+        where=name,
+        hint="route the build through models.gpt._DECODE_BUILD_CACHE "
+             "(_memo_build) keyed on the static config")]
+
+
+def _retrace_finding(name: str, axis: str, sspec: ServeSpec) -> list[Finding]:
+    """Flag a builder whose trace key includes an unbounded runtime value
+    (a per-prompt-length retrace) unless the deployment bounds it."""
+    if sspec.prompt_lens is not None:
+        return []
+    return [Finding(
+        rule="retrace-explosion.unbounded-trace-key",
+        severity=Severity.WARNING,
+        message=(f"'{name}' retraces per distinct {axis}, and this "
+                 f"deployment declares no bound on it — under real traffic "
+                 f"every new length is a fresh trace + XLA compile (the "
+                 f"trace cache grows without limit)"),
+        where=name,
+        hint="bucket prompt lengths (ServeSpec.prompt_lens / the "
+             "simulator's buckets) or serve the paged layout with a "
+             "prefill_chunk, which bounds prefill shapes to the chunk "
+             "size")]
+
+
+# -- abstract-arg builders -------------------------------------------------
+
+def _key_sds():
+    import jax
+    return jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+
+
+def _sds(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def build_registry(stages, sspec: ServeSpec
+                   ) -> tuple[list[Program], list[Finding]]:
+    """Build every compiled program of ``sspec``'s serve path with its
+    abstract args + contracts; returns (programs, policy findings) where
+    the findings are the retrace/memo checks that are not jaxpr rules."""
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        _cache_dtype,
+        make_cached_decoder,
+        make_paged_block_copy,
+        make_paged_decode_step,
+        make_paged_prefill_chunk,
+        make_slot_decode_step,
+        make_slot_prefill,
+    )
+
+    cfg = sspec.cfg
+    S, ml, bs = sspec.n_slots, sspec.ml, sspec.block_size
+    V = cfg.vocab
+    H = cfg.n_heads
+    dh = cfg.d_model // H
+    L = cfg.n_layers
+    NB = sspec.blocks_per_seq
+    n_blocks = sspec.nb
+    cd = _cache_dtype(sspec.cache_dtype)
+    params = abstractify([s.params for s in stages])
+
+    f32 = _sds((), np.float32)
+    f32S = _sds((S,), np.float32)
+    kd1 = _sds((2,), np.uint32)
+    kdS = _sds((S, 2), np.uint32)
+    toks = spec((S,), np.int32, 0, V - 1)
+    pos = spec((S,), np.int32, 0, ml - 1)
+    top_ks = spec((S,), np.int32, 0, V)
+    top_k1 = spec((), np.int32, 0, V)
+
+    programs: list[Program] = []
+    findings: list[Finding] = []
+
+    # the cached decoder: the solo-parity anchor every served request is
+    # bit-exact against — linted at one representative bucket
+    t0 = int(min(sspec.prompt_lens)) if sspec.prompt_lens else min(4, ml - 1)
+    t0 = max(1, min(t0, ml - 1))
+    n_new = ml - t0
+    findings += check_builder_memo(
+        "make_cached_decoder",
+        lambda: make_cached_decoder(stages, cfg, t0, n_new,
+                                    cache_dtype=sspec.cache_dtype))
+    findings += _retrace_finding("make_cached_decoder",
+                                 "(prompt_len, n_new) pair", sspec)
+    programs.append(Program(
+        "cached_decoder",
+        make_cached_decoder(stages, cfg, t0, n_new,
+                            cache_dtype=sspec.cache_dtype),
+        (params, spec((1, t0), np.int32, 0, V - 1), _key_sds())))
+
+    if sspec.kv_layout == "dense":
+        kc = _sds((L, S, H, ml, dh), cd)
+        prefill = make_slot_prefill(stages, cfg, ml, sspec.cache_dtype)
+        decode = make_slot_decode_step(stages, cfg, ml, sspec.cache_dtype)
+        findings += check_builder_memo(
+            "make_slot_prefill",
+            lambda: make_slot_prefill(stages, cfg, ml, sspec.cache_dtype))
+        findings += check_builder_memo(
+            "make_slot_decode_step",
+            lambda: make_slot_decode_step(stages, cfg, ml,
+                                          sspec.cache_dtype))
+        findings += _retrace_finding("make_slot_prefill", "prompt length",
+                                     sspec)
+        t0p = t0
+        prefill_args = (params, kc, kc, spec((1, t0p), np.int32, 0, V - 1),
+                        spec((), np.int32, 0, S - 1), kd1, f32, top_k1, f32)
+        decode_args = (params, kc, kc, toks, pos, kdS, f32S, top_ks, f32S)
+        programs.append(Program("slot_prefill", prefill, prefill_args))
+        programs.append(Program("slot_decode", decode, decode_args))
+
+        # the composite tick: prefill -> decode with the pool buffers
+        # THREADED the way engine.step does — donated-buffer flow across
+        # the program boundary is what the donation rules walk here
+        def dense_tick(params, kc, vc, prompt, slot, kd_1, t1, k1, p1,
+                       toks, pos, kds, temps, tks, tps):
+            kc, vc, tok, kd_1 = prefill(params, kc, vc, prompt, slot, kd_1,
+                                        t1, k1, p1)
+            kc, vc, toks2, kds2 = decode(params, kc, vc, toks, pos, kds,
+                                         temps, tks, tps)
+            return kc, vc, tok, toks2, kds2
+
+        programs.append(Program(
+            "dense_tick", dense_tick,
+            prefill_args[:1] + (kc, kc) + prefill_args[3:]
+            + decode_args[3:]))
+        return programs, findings
+
+    # paged layout
+    kc = _sds((L, n_blocks + 1, H, bs, dh), cd)
+    tables = spec((S, NB), np.int32, 0, n_blocks)
+    table1 = spec((NB,), np.int32, 0, n_blocks)
+    c = sspec.resolved_chunk
+    chunk = make_paged_prefill_chunk(stages, cfg, ml, bs, sspec.cache_dtype)
+    decode = make_paged_decode_step(stages, cfg, ml, bs, sspec.cache_dtype)
+    copy = make_paged_block_copy()
+    findings += check_builder_memo(
+        "make_paged_prefill_chunk",
+        lambda: make_paged_prefill_chunk(stages, cfg, ml, bs,
+                                         sspec.cache_dtype))
+    findings += check_builder_memo(
+        "make_paged_decode_step",
+        lambda: make_paged_decode_step(stages, cfg, ml, bs,
+                                       sspec.cache_dtype))
+    findings += check_builder_memo("make_paged_block_copy",
+                                   make_paged_block_copy)
+    if sspec.prefill_chunk is None:
+        findings += _retrace_finding("make_paged_prefill_chunk",
+                                     "chunk (= whole-prompt) length", sspec)
+
+    chunk_args = (params, kc, kc, spec((1, c), np.int32, 0, V - 1),
+                  spec((), np.int32, 0, ml - 1 - c), table1, kd1, f32,
+                  top_k1, f32)
+    decode_args = (params, kc, kc, toks, pos, tables, kdS, f32S, top_ks,
+                   f32S)
+    copy_args = (kc, kc, spec((), np.int32, 1, n_blocks),
+                 spec((), np.int32, 0, n_blocks))
+    programs.append(Program("paged_prefill_chunk", chunk, chunk_args))
+    programs.append(Program("paged_decode", decode, decode_args))
+    programs.append(Program("paged_block_copy", copy, copy_args))
+
+    # the composite tick: chunk -> CoW copy -> decode, pool buffers
+    # threaded exactly as engine.step/_ensure_writable_range thread them.
+    # A read of the pre-call buffer after any stage donated it is the
+    # cross-program read-after-donate the donation rules exist for.
+    def paged_tick(params, kc, vc, tokens, p0, table, kd_1, t1, k1, p1,
+                   dst, src, toks, pos, tables, kds, temps, tks, tps):
+        kc, vc, tok, kd_1 = chunk(params, kc, vc, tokens, p0, table, kd_1,
+                                  t1, k1, p1)
+        kc, vc = copy(kc, vc, dst, src)
+        kc, vc, toks2, kds2 = decode(params, kc, vc, toks, pos, tables,
+                                     kds, temps, tks, tps)
+        return kc, vc, tok, toks2, kds2
+
+    programs.append(Program(
+        "paged_tick", paged_tick,
+        chunk_args[:1] + (kc, kc) + chunk_args[3:] + copy_args[2:]
+        + decode_args[3:]))
+    return programs, findings
+
+
+# -- the HBM-bytes-per-tick model ------------------------------------------
+
+def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
+                   ) -> list[HBMCost]:
+    """Static K/V traffic per serve tick, the serving mirror of the ICI
+    cost table. Shapes are static — the batched decode gathers EVERY
+    slot's full table span every tick regardless of occupancy (that is the
+    design: one compiled program serves every tick), so the per-tick
+    stream sizes depend on block geometry and slot count only; what
+    occupancy changes is the RESIDENT bytes
+    (:func:`predict_kv_bytes_resident`)."""
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        _cache_dtype,
+    )
+    cfg = sspec.cfg
+    L = n_layers if n_layers is not None else cfg.n_layers
+    H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+    isz = np.dtype(_cache_dtype(sspec.cache_dtype)).itemsize
+    S, ml = sspec.n_slots, sspec.ml
+    row = 2 * H * dh * isz                      # K + V, one position, 1 layer
+    out: list[HBMCost] = []
+    if sspec.kv_layout == "paged":
+        span = sspec.blocks_per_seq * sspec.block_size
+        out.append(HBMCost(
+            "decode.kv_gather", "paged_decode", S * L * span * row,
+            note=f"{S} slots x {L} layers x {span}-row table span"))
+        out.append(HBMCost(
+            "decode.kv_scatter", "paged_decode", S * L * row,
+            note="one position per slot per layer"))
+        c = sspec.resolved_chunk
+        out.append(HBMCost(
+            "prefill.kv_scatter", "paged_prefill_chunk", c * L * row,
+            note=f"{c}-token chunk"))
+        out.append(HBMCost(
+            "prefill.kv_gather", "paged_prefill_chunk", L * span * row,
+            note="the chunk attends the gathered table span"))
+        out.append(HBMCost(
+            "cow.block_copy", "paged_block_copy",
+            L * sspec.block_size * row,
+            note="per copy-on-write divergence, all layers"))
+    else:
+        out.append(HBMCost(
+            "decode.kv_read", "slot_decode", S * L * ml * row,
+            note=f"{S} rows x {L} layers x max_len={ml}"))
+        out.append(HBMCost(
+            "decode.kv_scatter", "slot_decode", S * L * row,
+            note="one position per slot per layer"))
+    return out
+
+
+def predict_kv_bytes_resident(sspec: ServeSpec, rows_per_seq,
+                              n_layers: int | None = None) -> int:
+    """Model of the pool's ``serve_kv_bytes_resident`` gauge: bytes the
+    given live sequences pin, where each entry of ``rows_per_seq`` is one
+    sequence's written-row count (``prompt_len + tokens_emitted - 1`` once
+    decoding). Assumes no prefix sharing between the sequences — shared
+    blocks make the true gauge strictly smaller. Paged layout only (the
+    dense pool pins everything up front)."""
+    from simple_distributed_machine_learning_tpu.serve.slots import (
+        kv_block_bytes,
+    )
+    cfg = sspec.cfg
+    L = n_layers if n_layers is not None else cfg.n_layers
+    per_block = kv_block_bytes(L, cfg.n_heads, sspec.block_size,
+                               cfg.d_model // cfg.n_heads,
+                               sspec.cache_dtype)
+    blocks = sum(math.ceil(r / sspec.block_size) for r in rows_per_seq)
+    return blocks * per_block
+
+
+# -- the one-call preflights -----------------------------------------------
+
+def _injected_findings() -> list[Finding]:
+    tag = os.environ.get("SDML_LINT_INJECT")
+    if not tag:
+        return []
+    return [Finding(
+        rule=f"injected.{tag}", severity=Severity.ERROR,
+        message="seeded ERROR finding injected via SDML_LINT_INJECT — the "
+                "gate drill proving --lint preflights actually fail",
+        where="SDML_LINT_INJECT", hint="unset SDML_LINT_INJECT")]
+
+
+def lint_serve(stages, sspec: ServeSpec, name: str | None = None) -> Report:
+    """Trace and lint every compiled program of one serving deployment;
+    returns a single merged :class:`Report` carrying the findings of all
+    rule families, the retrace/memo policy checks and the
+    HBM-bytes-per-tick table."""
+    programs, policy = build_registry(stages, sspec)
+    n_layers = sum(len(p["blocks"]) for p in (s.params for s in stages))
+    label = name or (f"serve[{sspec.kv_layout} slots={sspec.n_slots} "
+                     f"max_len={sspec.ml}"
+                     + (f" block={sspec.block_size}"
+                        f" chunk={sspec.prefill_chunk}"
+                        if sspec.kv_layout == "paged" else "") + "]")
+    report = Report(name=label, findings=list(policy))
+    for prog in programs:
+        sub = analyze(prog.fn, *prog.args, name=f"{label}:{prog.name}")
+        for f in sub.findings:
+            report.findings.append(dataclasses.replace(
+                f, where=f"{prog.name}: {f.where}" if f.where
+                else prog.name))
+        report.costs.extend(sub.costs)
+    report.hbm.extend(hbm_tick_costs(sspec, n_layers=n_layers))
+    report.findings.extend(_injected_findings())
+    return report
+
+
+def default_registry_reports() -> list[Report]:
+    """The CI lint gate's serve-program sweep: one tiny GPT build linted
+    over the paged layout at two block/chunk shapes plus the dense layout,
+    all with the simulator's prompt buckets declared — every report must
+    be ERROR-free for the gate to pass (``--serve`` in the analysis
+    CLI)."""
+    import jax
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    cfg = GPTConfig(vocab=32, seq_len=24, d_model=16, n_heads=2, n_layers=2)
+    stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, 1)
+    buckets = (4, 8, 12)
+    specs = [
+        ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
+                  prefill_chunk=3, prompt_lens=buckets),
+        ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=8,
+                  prefill_chunk=None, prompt_lens=buckets),
+        ServeSpec(cfg, n_slots=4, kv_layout="dense", prompt_lens=buckets),
+    ]
+    return [lint_serve(stages, s) for s in specs]
+
+
+def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:
+    """Preflight a live :class:`~..serve.engine.InferenceEngine`'s EXACT
+    programs — same layout, block geometry, chunk size and cache dtype the
+    engine constructed (``InferenceEngine(lint=True)`` calls this at
+    construction)."""
+    pool = engine.pool
+    paged = engine.kv_layout == "paged"
+    sspec = ServeSpec(
+        cfg=engine.cfg, n_slots=pool.n_slots, max_len=engine.max_len,
+        kv_layout=engine.kv_layout,
+        block_size=pool.block_size if paged else 16,
+        n_blocks=pool.n_blocks if paged else None,
+        prefill_chunk=engine.prefill_chunk,
+        cache_dtype=pool.kc.dtype, prompt_lens=prompt_lens)
+    return lint_serve(engine.stages, sspec)

@@ -76,19 +76,40 @@ class CollectiveCost:
         return self.ici_bytes * self.trips
 
 
-class Report:
-    """The result of one ``analyze()`` run: findings + ICI cost table."""
+@dataclasses.dataclass(frozen=True)
+class HBMCost:
+    """HBM traffic estimate for one serving-program memory stream.
 
-    def __init__(self, name: str = "", findings=None, costs=None):
+    The serving twin of :class:`CollectiveCost`: where a train step's
+    dominant off-chip traffic is collective bytes over ICI, a decode tick's
+    is K/V cache bytes over HBM — the paged gather reads every table block
+    of every slot each tick, and the scatter lands one position per slot.
+    ``bytes_per_tick`` is the static program cost (shapes are static, so it
+    does not vary with occupancy); ``bytes_resident`` models what occupancy
+    actually PINS (cross-checked against the pool's
+    ``serve_kv_bytes_resident`` gauge in tests)."""
+    op: str                   # e.g. "decode.kv_gather"
+    program: str              # registry program the stream belongs to
+    bytes_per_tick: int
+    note: str = ""
+
+
+class Report:
+    """The result of one ``analyze()`` run: findings + ICI cost table
+    (+ the serving HBM-bytes-per-tick table when the registry adds one)."""
+
+    def __init__(self, name: str = "", findings=None, costs=None, hbm=None):
         self.name = name
         self.findings: list[Finding] = list(findings or [])
         self.costs: list[CollectiveCost] = list(costs or [])
+        self.hbm: list[HBMCost] = list(hbm or [])
 
     # -- aggregation ------------------------------------------------------
 
     def extend(self, other: "Report") -> "Report":
         self.findings.extend(other.findings)
         self.costs.extend(other.costs)
+        self.hbm.extend(other.hbm)
         return self
 
     @property
@@ -135,6 +156,16 @@ class Report:
                              f"{_human_bytes(rest)}")
             total = sum(c.total_bytes for c in self.costs)
             lines.append(f"    total: {_human_bytes(total)}")
+        if costs and self.hbm:
+            lines.append("  HBM bytes per serve tick (KV-cache streams):")
+            for h in sorted(self.hbm, key=lambda h: -h.bytes_per_tick):
+                note = f"  ({h.note})" if h.note else ""
+                lines.append(
+                    f"    {h.op:<24} {_human_bytes(h.bytes_per_tick):>10}  "
+                    f"{h.program}{note}")
+            lines.append(
+                f"    total: "
+                f"{_human_bytes(sum(h.bytes_per_tick for h in self.hbm))}")
         return "\n".join(lines)
 
 

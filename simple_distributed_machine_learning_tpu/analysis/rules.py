@@ -140,11 +140,30 @@ class Walker:
 
     # -- entry points -----------------------------------------------------
 
-    def visit_outer(self, jaxpr) -> None:
+    def visit_outer(self, jaxpr, in_vary=None) -> list:
         """Walk a jaxpr OUTSIDE any shard_map: track donation, enter
-        shard_maps, recurse through call-like eqns."""
+        shard_maps, recurse through call-like eqns.
+
+        ``in_vary`` optionally seeds DECLARED device-variance per invar (a
+        caller's ``analysis.spec(..., vary=('data',))`` contract): a buffer
+        whose shape is replicated but whose CONTENT each device holds a
+        different shard of — exactly a ZeRO opt-state shard in the
+        check_rep=False era, which no ``in_names`` can express. The
+        variance threads through call-like eqns into every shard_map's
+        replication inference, where a consume-without-gather surfaces as a
+        missing reduction (re-tagged ``sharded-state`` by run_rules).
+        Returns the out-vars' variance (for the recursion)."""
         jaxpr = open_jaxpr(jaxpr)
         donated: dict[int, str] = {}       # id(var) -> donation site
+        vary: dict[int, frozenset] = {}
+        if in_vary:
+            for var, v in zip(jaxpr.invars, in_vary):
+                if v:
+                    vary[id(var)] = frozenset(v)
+
+        def _vary_of(atoms):
+            return [vary.get(id(v), EMPTY) for v in atoms]
+
         for eqn in jaxpr.eqns:
             prim = eqn.primitive.name
             for invar in eqn.invars:
@@ -162,7 +181,7 @@ class Walker:
             if prim == "shard_map":
                 self._path.append("shard_map")
                 try:
-                    self._visit_shard_map(eqn)
+                    self._visit_shard_map(eqn, incoming=_vary_of(eqn.invars))
                 finally:
                     self._path.pop()
             elif prim in RENDEZVOUS_PRIMS or prim == "axis_index":
@@ -184,16 +203,69 @@ class Walker:
                         else f"scan[x{trips}]" if prim == "scan" else prim)
                     self._trips *= trips
                     try:
-                        self.visit_outer(sub)
+                        # map eqn invars onto this sub-jaxpr's params:
+                        # cond branches drop the predicate, while's two
+                        # jaxprs each see their own consts + the carry —
+                        # declared vary= contracts must thread through
+                        # these boundaries, not silently reset
+                        if prim == "cond":
+                            ev = list(eqn.invars)[1:]
+                        elif prim == "while":
+                            cnc = eqn.params.get("cond_nconsts", 0)
+                            bnc = eqn.params.get("body_nconsts", 0)
+                            iv = list(eqn.invars)
+                            ev = (iv[:cnc] + iv[cnc + bnc:]
+                                  if key == "cond_jaxpr" else iv[cnc:])
+                        else:
+                            ev = list(eqn.invars)
+                        sub_vary = (_vary_of(ev)
+                                    if len(sub.invars) == len(ev)
+                                    else None)
+                        outs = self.visit_outer(sub, in_vary=sub_vary)
                     finally:
                         self._trips //= trips
                         self._path.pop()
+                    if (vary and key != "cond_jaxpr"
+                            and len(outs) >= len(eqn.outvars)):
+                        # union across sub-jaxprs (cond/switch branches):
+                        # ANY branch's variance survives the join — the
+                        # last branch overwriting would certify a defect
+                        # reachable only through an earlier branch
+                        for var, v in zip(eqn.outvars, outs):
+                            if v:
+                                vary[id(var)] = (
+                                    vary.get(id(var), frozenset()) | v)
+                if vary and not any(True for _ in subjaxprs(eqn)):
+                    # plain eqn: declared variance flows through
+                    union = frozenset().union(*_vary_of(eqn.invars)) \
+                        if eqn.invars else EMPTY
+                    if union:
+                        for var in eqn.outvars:
+                            vary[id(var)] = union
             if prim == "pjit":
                 don = eqn.params.get("donated_invars") or ()
                 site = self._where(eqn)
+                seen_at: dict[int, bool] = {}   # id(var) -> any donated
+                flagged: set[int] = set()   # one finding per (eqn, buffer)
                 for invar, d in zip(eqn.invars, don):
-                    if d and hasattr(invar, "aval"):
-                        donated[id(invar)] = site
+                    if not hasattr(invar, "aval"):
+                        continue
+                    key = id(invar)
+                    if (key in seen_at and (d or seen_at[key])
+                            and key not in flagged):
+                        flagged.add(key)
+                        self._emit(
+                            "donation.double-donation", Severity.ERROR,
+                            f"the same buffer is passed twice to "
+                            f"'{eqn.params.get('name', 'pjit')}' with at "
+                            f"least one position donated — the donated "
+                            f"pages may be reused while the aliased "
+                            f"parameter still reads them", eqn,
+                            hint="pass distinct buffers, or drop the "
+                                 "aliased position from donate_argnums")
+                    seen_at[key] = seen_at.get(key, False) or bool(d)
+                    if d:
+                        donated[key] = site
         for outvar in jaxpr.outvars:
             if id(outvar) in donated:
                 self._emit(
@@ -203,8 +275,9 @@ class Walker:
                     f"donated buffer", None,
                     hint="return the updated value instead of the donated "
                          "input")
+        return _vary_of(jaxpr.outvars)
 
-    def _visit_shard_map(self, eqn) -> None:
+    def _visit_shard_map(self, eqn, incoming=None) -> None:
         axes = _mesh_axes_of(eqn, self.active_mesh)
         ctx = _MeshCtx(axes)
         inner = open_jaxpr(eqn.params["jaxpr"])
@@ -214,6 +287,11 @@ class Walker:
             in_vmas = [EMPTY for _ in inner.invars]
         else:
             in_vmas = [_names_to_axes(n) for n in in_names]
+        if incoming:
+            # declared content-variance (ZeRO shards in replicated-shape
+            # buffers) joins whatever in_names already map
+            in_vmas = [v | inc for v, inc in
+                       zip(in_vmas, incoming + [EMPTY] * len(in_vmas))]
         # cross-check the traced mesh against the launch mesh
         if self.active_mesh is not None:
             active = {n: int(s) for n, s in dict(self.active_mesh.shape).items()}
@@ -631,8 +709,48 @@ class Walker:
             trips=self._trips, where=self._where(eqn)))
 
 
-def run_rules(closed_jaxpr, active_mesh=None):
-    """Run every lint pass over a traced step; returns (findings, costs)."""
+def run_rules(closed_jaxpr, active_mesh=None, arg_ranges=None, arg_vary=None):
+    """Run every lint pass over a traced step; returns (findings, costs).
+
+    ``arg_ranges``/``arg_vary`` are flat per-invar contract annotations
+    (from ``analysis.spec`` args, see ``analyze``): value intervals engage
+    the scatter-bounds interval pass; declared device-variance engages the
+    sharded-state pass — the replication inference runs twice, and a
+    missing-reduction finding present ONLY under the declared shards is
+    re-tagged ``sharded-state.missing-gather`` (the defect is consuming a
+    sharded buffer without gathering it, not a dropped gradient psum).
+    """
+    import dataclasses
+
     w = Walker(active_mesh=active_mesh)
-    w.visit_outer(closed_jaxpr)
-    return w.findings, w.costs
+    w.visit_outer(closed_jaxpr, in_vary=arg_vary)
+    findings, costs = w.findings, w.costs
+
+    if arg_vary and any(arg_vary):
+        base = Walker(active_mesh=active_mesh)
+        base.visit_outer(closed_jaxpr)
+        base_keys = {(f.rule, f.where) for f in base.findings}
+        retagged = []
+        for f in findings:
+            if (f.rule == "unreduced-gradient.missing-reduce"
+                    and (f.rule, f.where) not in base_keys):
+                f = dataclasses.replace(
+                    f, rule="sharded-state.missing-gather",
+                    message=("a buffer DECLARED device-sharded (a ZeRO "
+                             "param/opt-state shard in a replicated-shape "
+                             "buffer) flows into this output without a "
+                             "gather/reduce: " + f.message),
+                    hint="all_gather the shard (or psum the partial) over "
+                         "the declared axis before it meets replicated "
+                         "state — gather-before-use / reduce-before-update")
+            retagged.append(f)
+        findings = retagged
+
+    # The bounds pass always runs — even with no declared contracts, a
+    # PROMISE_IN_BOUNDS gather/scatter must surface as unproven-promise
+    # rather than analyze vacuously clean (an empty report is a proof).
+    from simple_distributed_machine_learning_tpu.analysis.bounds import (
+        check_bounds,
+    )
+    findings = findings + check_bounds(closed_jaxpr, list(arg_ranges or ()))
+    return findings, costs

@@ -57,6 +57,25 @@ def subjaxprs(eqn) -> Iterator[tuple[str, int, Any]]:
                 yield key, i, open_jaxpr(v)
 
 
+def all_primitives(jaxpr) -> frozenset:
+    """Every primitive name reachable in a (closed) jaxpr, recursing
+    through all sub-jaxprs via :func:`subjaxprs` — the coverage audit the
+    serve-registry regression test pins: if a program emits a primitive the
+    generic recursion cannot reach (a new call-like primitive whose jaxpr
+    hides in an unprobed param), it will be missing here and the test
+    snaps."""
+    out: set = set()
+
+    def walk(j):
+        for eqn in open_jaxpr(j).eqns:
+            out.add(eqn.primitive.name)
+            for _key, _i, sub in subjaxprs(eqn):
+                walk(sub)
+
+    walk(open_jaxpr(jaxpr))
+    return frozenset(out)
+
+
 def norm_axes(axes: Any) -> tuple[str, ...]:
     """Collective axis params normalized to a tuple of NAMED axes (positional
     int axes from vmap land are not mesh axes and are dropped)."""

@@ -278,14 +278,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "end-to-end check CI pairs with --telemetry-dir")
     g.add_argument('--lint', action='store_true',
                    help="static-analysis preflight (analysis/): trace the "
-                        "exact compiled train+eval steps this run is about "
-                        "to execute and lint them (ppermute deadlocks, "
-                        "unreduced gradients, mesh-axis validity, dtype "
-                        "drift, donation hazards) before any device "
-                        "executes a step; abort on ERROR findings")
+                        "exact compiled steps this run is about to execute "
+                        "and lint them before any device executes one — "
+                        "train+eval steps for a training run (ppermute "
+                        "deadlocks, unreduced gradients, mesh-axis "
+                        "validity, dtype drift, donation hazards); the "
+                        "whole serving-program registry for --serve-sim "
+                        "(KV scatter-bounds, donated-buffer flow through "
+                        "the tick, retrace policy, HBM bytes/tick); abort "
+                        "on ERROR findings")
     g.add_argument('--lint-only', action='store_true',
                    help="run the --lint preflight and exit without "
-                        "training (exit 0 clean, 2 on ERROR findings)")
+                        "training/serving (exit 0 clean, 2 on ERROR "
+                        "findings)")
     g.add_argument('--peer-timeout', type=float, default=60.0,
                    help="multi-process dead-peer watchdog: abort with a "
                         "nonzero exit if a peer crashes or stops "
@@ -671,6 +676,30 @@ def _run_serve(args, n_stages: int, key) -> None:
             f"({max(GPT_SERVE_PROMPTS)}) + 1 token must fit seq_len "
             f"{cfg.seq_len}")
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
+    if args.lint or args.lint_only:
+        # the serve-path preflight gate: trace and lint the EXACT compiled
+        # programs the ticks below will execute (block/position contracts
+        # via the scatter-bounds interval pass, donated-buffer flow through
+        # the composite tick, retrace policy against the simulator's
+        # prompt buckets, HBM-bytes-per-tick table) — zero FLOPs, nothing
+        # allocated yet
+        from simple_distributed_machine_learning_tpu.analysis.programs import (
+            ServeSpec,
+            lint_serve,
+        )
+        buckets = tuple(args.serve_shared_prefix + p
+                        for p in GPT_SERVE_PROMPTS)
+        report = lint_serve(stages, ServeSpec(
+            cfg, n_slots=args.serve_slots, kv_layout="paged",
+            block_size=args.serve_block_size,
+            prefill_chunk=(args.serve_prefill_chunk or None),
+            prompt_lens=buckets))
+        print(report.format(costs=True))
+        if not report.ok():
+            raise SystemExit(2)
+        print("| serve --lint: preflight clean")
+        if args.lint_only:
+            return
     params = None
     ckpt = (os.path.join(args.checkpoint_dir, "state.npz")
             if args.checkpoint_dir else None)

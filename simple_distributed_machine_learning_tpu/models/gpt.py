@@ -1271,6 +1271,21 @@ def make_paged_block_copy():
     return _memo_build(("paged_block_copy",), build)
 
 
+# The memoized decode-path builders, by name — the single list the
+# analyzer's program registry and host-side AST lint key off
+# (analysis/programs.py enumerates these as compiled entry points;
+# analysis/hostlint.py checks each definition routes through _memo_build
+# and that no call site bypasses it).
+DECODE_BUILDERS = {
+    "make_cached_decoder": make_cached_decoder,
+    "make_slot_prefill": make_slot_prefill,
+    "make_slot_decode_step": make_slot_decode_step,
+    "make_paged_prefill_chunk": make_paged_prefill_chunk,
+    "make_paged_decode_step": make_paged_decode_step,
+    "make_paged_block_copy": make_paged_block_copy,
+}
+
+
 def decoder_from_pipeline(pipe, cfg: GPTConfig, prompt_len: int, n_new: int,
                           temperature: float = 0.0, top_k: int | None = None,
                           top_p: float | None = None, cache_dtype=None):

@@ -91,7 +91,7 @@ class InferenceEngine:
                  n_blocks: int | None = None, prefill_chunk: int | None = None,
                  metrics: ServeMetrics | None = None,
                  scheduler: FCFSScheduler | None = None,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic, lint: bool = False) -> None:
         from simple_distributed_machine_learning_tpu.models.gpt import (
             make_paged_block_copy,
             make_paged_decode_step,
@@ -112,6 +112,7 @@ class InferenceEngine:
                 "prefill_chunk/n_blocks are paged-pool knobs; the dense "
                 "layout prefills whole prompts into fixed rows")
         self.cfg = cfg
+        self.stages = stages       # kept for the analyzer's program registry
         self.kv_layout = kv_layout
         self.prefill_chunk = prefill_chunk
         self.params = (params if params is not None
@@ -143,6 +144,21 @@ class InferenceEngine:
             scheduler = scheduler(self.pool)
         self.scheduler = scheduler
         self.scheduler.attach(self)
+        if lint:
+            # preflight the EXACT compiled programs this engine just built
+            # (analysis/programs.py registry: scatter-bounds over the
+            # block/position contracts, donation flow through the tick,
+            # retrace policy) — trace-only, no FLOPs; construction fails
+            # loudly on any ERROR finding rather than serving corruptable
+            # programs
+            from simple_distributed_machine_learning_tpu.analysis.programs import (  # noqa: E501
+                lint_engine,
+            )
+            report = lint_engine(self)
+            if not report.ok():
+                raise RuntimeError(
+                    "InferenceEngine(lint=True): the serve-program "
+                    "preflight found ERROR findings:\n" + report.format())
         self.metrics = metrics
         self._clock = clock
         self._next_rid = 0

@@ -50,6 +50,24 @@ import math
 import numpy as np
 
 
+def kv_block_bytes(n_layers: int, n_heads: int, block_size: int,
+                   head_dim: int, cache_dtype=None) -> int:
+    """Bytes one physical K/V block pins across every layer (K and V).
+
+    The ONE copy of the formula: :class:`PagedKVPool` sizes its
+    ``bytes_per_block`` (and therefore the ``serve_kv_bytes_resident``
+    gauge) from it, and the analyzer's HBM-bytes-per-tick model
+    (``analysis/programs.py``) predicts against it — the cross-check in
+    tests/test_analysis_serve.py holds because both sides share this."""
+    import jax.numpy as jnp
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        _cache_dtype,
+    )
+    return int(2 * n_layers * n_heads * block_size * head_dim
+               * jnp.dtype(_cache_dtype(cache_dtype)).itemsize)
+
+
 def _bind_seq_of(request) -> np.ndarray:
     """The sequence admission must budget/prefill for: ``resume_seq`` when
     the request tracks preemption state, its plain prompt otherwise (raw
@@ -238,9 +256,8 @@ class PagedKVPool(_SlotPoolBase):
         shape = (n_layers, n_blocks + 1, n_heads, block_size, head_dim)
         self.kc = jnp.zeros(shape, cd)
         self.vc = jnp.zeros(shape, cd)
-        self.bytes_per_block = int(
-            2 * n_layers * n_heads * block_size * head_dim
-            * jnp.dtype(cd).itemsize)
+        self.bytes_per_block = kv_block_bytes(n_layers, n_heads, block_size,
+                                              head_dim, cd)
         # block bookkeeping (host-side, authoritative)
         self.ref = np.zeros(n_blocks + 1, np.int64)
         self._free_blocks: list[int] = list(range(1, n_blocks + 1))[::-1]

@@ -58,6 +58,8 @@ def _train_report(pipe, batch, in_dim, opt=None):
 @pytest.mark.parametrize("name", [
     "partial_ppermute", "dropped_grad_sync", "wrong_axis_name",
     "bf16_psum_accumulator", "read_after_donate",
+    "oob_block_table", "cow_read_after_donate", "unmemoized_retrace",
+    "dropped_gather_before_use",
 ])
 def test_seeded_defect_is_flagged(name):
     fx = FIXTURES[name]
@@ -84,10 +86,80 @@ def test_seeded_defect_severities():
     assert "dtype-drift.low-precision-carry" in rules
 
 
+def test_new_family_defect_severities():
+    # the serve-path defect classes are all ERRORs: silent K/V corruption,
+    # device use-after-free and unmemoized recompiles must gate --lint
+    for name in ("oob_block_table", "cow_read_after_donate",
+                 "unmemoized_retrace", "dropped_gather_before_use"):
+        assert FIXTURES[name].build().errors, name
+
+
 def test_clean_fixtures_pass():
-    for name in ("clean_grad_sync", "clean_pipeline_step"):
+    for name in ("clean_grad_sync", "clean_pipeline_step",
+                 "clean_cow_tick", "clean_gather_before_use"):
         report = FIXTURES[name].build()
         assert report.ok(fail_on="warning"), report.format()
+
+
+def test_sharded_state_vary_threads_through_cond_and_while():
+    """Declared ``vary=`` contracts must survive cond/switch and while
+    sub-jaxpr boundaries, whose invars are NOT arity-identical to the
+    eqn's (branches drop the predicate; while's two jaxprs each see their
+    own consts + the carry). The dropped-gather defect wrapped in a
+    lax.cond used to analyze vacuously clean — a certified-clean report
+    for a silently-diverging-params program."""
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from simple_distributed_machine_learning_tpu.analysis import spec
+    from simple_distributed_machine_learning_tpu.analysis.fixtures import (
+        _mesh,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        shard_map,
+    )
+
+    mesh = _mesh(4)
+
+    def _inner(reduced):
+        def step(w, m, g):
+            m2 = 0.9 * m + g
+            if reduced:
+                m2 = lax.pmean(m2, "data")
+            return w - 0.1 * m2, m2
+        return shard_map(step, mesh=mesh, in_specs=(P(), P(), P()),
+                         out_specs=(P(), P()), check_vma=False)
+
+    w = jax.ShapeDtypeStruct((16, 4), jax.numpy.float32)
+    g = jax.ShapeDtypeStruct((16, 4), jax.numpy.float32)
+    m = spec((16, 4), np.float32, vary=("data",))
+    pred = jax.ShapeDtypeStruct((), jax.numpy.bool_)
+
+    def behind_cond(reduced):
+        inner = _inner(reduced)
+        return lambda p, w, m, g: lax.cond(
+            p, inner, lambda w, m, g: (w, m), w, m, g)
+
+    def behind_while(w_, m_, g_):
+        inner = _inner(False)
+        def body(c):
+            i, cw, cm = c
+            nw, nm = inner(cw, cm, g_)
+            return i + 1, nw, nm
+        return lax.while_loop(lambda c: c[0] < 3, body, (0, w_, m_))
+
+    def rules(report):
+        return {f.rule for f in report.findings}
+
+    assert "sharded-state.missing-gather" in rules(
+        analyze(behind_cond(False), pred, w, m, g, mesh=mesh))
+    assert "sharded-state.missing-gather" in rules(
+        analyze(behind_while, w, m, g, mesh=mesh))
+    # the reduced twin stays clean through the same boundary — threading
+    # must not invent variance the pmean already retired
+    assert not any("sharded-state" in r for r in rules(
+        analyze(behind_cond(True), pred, w, m, g, mesh=mesh)))
 
 
 # ---- 2. shipping model/schedule combos analyze clean --------------------
@@ -266,4 +338,5 @@ def test_severity_ordering_and_families():
     assert Severity.ERROR > Severity.WARNING > Severity.INFO
     fams = {fx.family for fx in FIXTURES.values() if fx.defect}
     assert fams == {"ppermute-deadlock", "unreduced-gradient", "mesh-axis",
-                    "dtype-drift", "donation"}
+                    "dtype-drift", "donation", "scatter-bounds",
+                    "retrace-explosion", "sharded-state"}

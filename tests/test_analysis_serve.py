@@ -1,0 +1,529 @@
+"""Serve-path preflight (analysis/programs.py + bounds.py + hostlint.py).
+
+Four contracts pin the whole-program gate:
+
+1. the serving-program registry lints CLEAN on both KV layouts — and not
+   vacuously: the interval pass must PROVE every PROMISE_IN_BOUNDS gather
+   (zero ``unproven-promise`` findings), and the trace recursion must reach
+   every program (zero ``trace.failed``);
+2. contract violations the host-side pool guards against are flagged when
+   declared possible — block-table entries past the pool, position counters
+   past ``max_len`` — each as a ``scatter-bounds`` ERROR;
+3. the retrace policy and ``_DECODE_BUILD_CACHE`` memo discipline are
+   machine-checked (jaxpr-invisible, so checked at the builder/AST level);
+4. the HBM model's resident-bytes prediction equals the live pool's
+   ``serve_kv_bytes_resident`` gauge on multiple occupancy/block shapes.
+
+Everything except the HBM cross-check is trace-only.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.analysis import analyze, spec
+from simple_distributed_machine_learning_tpu.analysis.bounds import (
+    Interval,
+    _cmp_iv,
+    _floordiv_iv,
+    _mod_iv,
+)
+from simple_distributed_machine_learning_tpu.analysis.programs import (
+    ServeSpec,
+    build_registry,
+    check_builder_memo,
+    hbm_tick_costs,
+    lint_engine,
+    lint_serve,
+    predict_kv_bytes_resident,
+)
+from simple_distributed_machine_learning_tpu.analysis.trace import (
+    all_primitives,
+    trace_to_jaxpr,
+)
+from simple_distributed_machine_learning_tpu.models.gpt import (
+    DECODE_BUILDERS,
+    GPTConfig,
+    make_gpt_stages,
+    make_paged_decode_step,
+)
+
+CFG = GPTConfig(vocab=32, seq_len=24, d_model=16, n_heads=2, n_layers=2)
+BUCKETS = (4, 6, 9)
+
+
+@pytest.fixture(scope="module")
+def stages():
+    return make_gpt_stages(jax.random.key(0), CFG, 1)[0]
+
+
+def _specs():
+    return [
+        ServeSpec(CFG, n_slots=3, max_len=16, kv_layout="paged",
+                  block_size=4, prefill_chunk=3, prompt_lens=BUCKETS),
+        ServeSpec(CFG, n_slots=3, max_len=16, kv_layout="paged",
+                  block_size=8, prefill_chunk=None, prompt_lens=BUCKETS),
+        ServeSpec(CFG, n_slots=3, max_len=16, kv_layout="dense",
+                  prompt_lens=BUCKETS),
+    ]
+
+
+# ---- 1. the registry lints clean on both layouts -------------------------
+
+@pytest.mark.parametrize("i", range(3))
+def test_registry_clean_both_layouts(stages, i):
+    report = lint_serve(stages, _specs()[i])
+    assert report.ok(fail_on="warning"), report.format()
+    # the clean pass is a PROOF, not silence: the paged gathers run in
+    # PROMISE_IN_BOUNDS mode, so an unproven interval would have warned
+    rules = {f.rule for f in report.findings}
+    assert "scatter-bounds.unproven-promise" not in rules
+    assert "trace.failed" not in rules
+
+
+def test_registry_covers_every_decode_builder(stages):
+    # the paged + dense registries together enumerate every memoized
+    # decode builder (plus the composite ticks)
+    names = set()
+    for s in _specs():
+        programs, _ = build_registry(stages, s)
+        names.update(p.name for p in programs)
+    assert {"cached_decoder", "slot_prefill", "slot_decode",
+            "paged_prefill_chunk", "paged_decode", "paged_block_copy",
+            "dense_tick", "paged_tick"} <= names
+
+
+def test_trace_recursion_reaches_serve_primitives(stages):
+    """The trace.py audit, pinned: the generic sub-jaxpr recursion reaches
+    the index-bearing primitives the serve programs actually emit —
+    including the scatter/gather/dynamic_update_slice INSIDE the cached
+    decoder's scan — and no program fails to trace."""
+    prims = set()
+    for s in _specs():
+        programs, _ = build_registry(stages, s)
+        for prog in programs:
+            plain = jax.tree.map(
+                lambda a: a.sds if hasattr(a, "sds") else a, prog.args,
+                is_leaf=lambda a: hasattr(a, "sds"))
+            prims |= all_primitives(trace_to_jaxpr(prog.fn, *plain))
+    assert {"scatter", "gather", "dynamic_update_slice", "dynamic_slice",
+            "scan", "pjit", "argmax", "concatenate", "iota"} <= prims
+
+
+def test_hbm_table_present_and_ranked(stages):
+    report = lint_serve(stages, _specs()[0])
+    assert report.hbm, "HBM cost table empty"
+    ops = {h.op for h in report.hbm}
+    assert {"decode.kv_gather", "decode.kv_scatter",
+            "prefill.kv_scatter", "cow.block_copy"} <= ops
+    gather = next(h for h in report.hbm if h.op == "decode.kv_gather")
+    scatter = next(h for h in report.hbm if h.op == "decode.kv_scatter")
+    # the per-tick gather (full table span, every slot) dominates the
+    # one-position scatter — the ratio IS the span
+    assert gather.bytes_per_tick == scatter.bytes_per_tick * 16
+    assert "HBM bytes per serve tick" in report.format()
+
+
+def test_hbm_prefill_chunk_matches_registry_resolution():
+    """The HBM table's prefill row must describe the chunk the registry
+    actually built — ONE resolution rule (ServeSpec.resolved_chunk) for
+    both, including the no-chunk/no-buckets default every
+    ``InferenceEngine(lint=True)`` deployment hits."""
+    for s in (_specs()[0], _specs()[1],
+              ServeSpec(CFG, n_slots=2, max_len=16, block_size=4)):
+        row = next(h for h in hbm_tick_costs(s)
+                   if h.op == "prefill.kv_scatter")
+        assert f"{s.resolved_chunk}-token" in row.note, (row.note, s)
+    # the default deployment lints an 8-token chunk, not a 1-token one
+    assert ServeSpec(CFG, n_slots=2, max_len=16,
+                     block_size=4).resolved_chunk == 8
+
+
+# ---- 2. contract violations are flagged ----------------------------------
+
+def _paged_decode_args(stages, tables_hi, pos_hi, S=2, ml=16, bs=4):
+    nb = -(-ml // bs) * S
+    params = [jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), s.params)
+        for s in stages]
+    kc = jax.ShapeDtypeStruct(
+        (CFG.n_layers, nb + 1, CFG.n_heads, bs,
+         CFG.d_model // CFG.n_heads), np.float32)
+    return (params, kc, kc,
+            spec((S,), np.int32, 0, CFG.vocab - 1),
+            spec((S,), np.int32, 0, pos_hi),
+            spec((S, -(-ml // bs)), np.int32, 0, tables_hi),
+            jax.ShapeDtypeStruct((S, 2), np.uint32),
+            jax.ShapeDtypeStruct((S,), np.float32),
+            spec((S,), np.int32, 0, CFG.vocab),
+            jax.ShapeDtypeStruct((S,), np.float32)), nb
+
+
+def test_oob_table_and_position_flagged(stages):
+    step = make_paged_decode_step(stages, CFG, 16, 4)
+    args, nb = _paged_decode_args(stages, tables_hi=None, pos_hi=15)
+    args = list(args)
+    good_tables = spec((2, 4), np.int32, 0, nb)
+    args[5] = good_tables
+    assert analyze(step, *args).ok(fail_on="warning")
+    # table entries one past the pool: the K/V scatter lands in (or the
+    # PROMISE gather reads) someone else's block
+    args[5] = spec((2, 4), np.int32, 0, nb + 1)
+    report = analyze(step, *args)
+    oob = [f for f in report.findings
+           if f.rule == "scatter-bounds.out-of-range"]
+    assert oob and not report.ok(), report.format()
+    # position one past max_len: the pos-table gather and block math break
+    args[5] = good_tables
+    args[4] = spec((2,), np.int32, 0, 16)
+    report = analyze(step, *args)
+    assert not report.ok(), report.format()
+
+
+def test_unbounded_inputs_warn_on_promise_gathers(stages):
+    # no declared contract at all: the PROMISE_IN_BOUNDS block gathers
+    # cannot be proven — the analyzer must say so rather than stay silent
+    step = make_paged_decode_step(stages, CFG, 16, 4)
+    args, _ = _paged_decode_args(stages, tables_hi=None, pos_hi=15)
+    args = list(args)
+    args[5] = jax.ShapeDtypeStruct((2, 4), np.int32)   # tables: no contract
+    args[4] = spec((2,), np.int32, 0, 15)
+    report = analyze(step, *args)
+    assert any(f.rule == "scatter-bounds.unproven-promise"
+               for f in report.findings), report.format()
+    assert report.ok()      # WARNING: unproven, not proven-broken
+
+
+def test_double_donation_flagged():
+    # one buffer aliased into two parameters of a call that donates one of
+    # them: the non-donated alias reads pages the donation may reuse
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def inner(a, b):
+        return a + b
+
+    def tick(x):
+        return inner(x, x)
+
+    report = analyze(tick, jax.ShapeDtypeStruct((4,), np.float32))
+    assert any(f.rule == "donation.double-donation"
+               for f in report.findings), report.format()
+    # distinct buffers: clean
+    clean = analyze(lambda x, y: inner(x, y),
+                    jax.ShapeDtypeStruct((4,), np.float32),
+                    jax.ShapeDtypeStruct((4,), np.float32))
+    assert not any(f.rule == "donation.double-donation"
+                   for f in clean.findings), clean.format()
+
+
+def test_while_cond_gathers_not_vacuously_clean(stages):
+    # an index-bearing PROMISE read in a while-loop PREDICATE is a program
+    # too: the bounds pass must walk cond_jaxpr, not just the body
+    def f(table, idx):
+        def cond(c):
+            i, _ = c
+            return table.at[i].get(mode="promise_in_bounds") > 0
+        def body(c):
+            i, s = c
+            return i + 1, s + 1
+        return jax.lax.while_loop(cond, body, (idx, 0))
+
+    t = jax.ShapeDtypeStruct((8,), np.int32)
+    unproven = analyze(f, t, jax.ShapeDtypeStruct((), np.int32))
+    assert any(f_.rule == "scatter-bounds.unproven-promise"
+               for f_ in unproven.findings), unproven.format()
+
+
+def test_no_contracts_at_all_still_runs_bounds(stages):
+    # zero analysis.spec args anywhere: the bounds pass must still walk
+    # the program (rules.py runs check_bounds unconditionally) — a
+    # PROMISE_IN_BOUNDS gather in a spec-free analyze() call is the
+    # vacuously-clean hole, not a clean proof
+    step = make_paged_decode_step(stages, CFG, 16, 4)
+    args, _ = _paged_decode_args(stages, tables_hi=None, pos_hi=15)
+    plain = [jax.ShapeDtypeStruct(a.sds.shape, a.sds.dtype)
+             if hasattr(a, "sds") else a for a in args]
+    report = analyze(step, *plain)
+    assert any(f.rule == "scatter-bounds.unproven-promise"
+               for f in report.findings), report.format()
+    assert report.ok(), report.format()
+
+
+# ---- 3. retrace policy + memo discipline ---------------------------------
+
+def test_real_builders_are_memoized(stages):
+    for name, make in DECODE_BUILDERS.items():
+        if name == "make_cached_decoder":
+            def build():
+                return make(stages, CFG, 4, 4)
+        elif name == "make_paged_block_copy":
+            build = make
+        elif "paged" in name:
+            def build():
+                return make(stages, CFG, 16, 4)
+        else:
+            def build():
+                return make(stages, CFG, 16)
+        assert check_builder_memo(name, build) == [], name
+
+
+def test_unbounded_retrace_flagged_bounded_clean(stages):
+    unbounded = ServeSpec(CFG, n_slots=2, max_len=16, kv_layout="dense")
+    report = lint_serve(stages, unbounded)
+    assert any(f.rule == "retrace-explosion.unbounded-trace-key"
+               for f in report.findings), report.format()
+    assert report.ok()                      # WARNING-level: gates don't trip
+    bounded = ServeSpec(CFG, n_slots=2, max_len=16, kv_layout="dense",
+                        prompt_lens=BUCKETS)
+    assert lint_serve(stages, bounded).ok(fail_on="warning")
+    # paged: a prefill_chunk bounds the SERVING shapes even with no
+    # buckets — the only remaining warning is the cached (solo-parity)
+    # decoder, whose per-(prompt, n_new) retrace is caller-owned
+    chunked = ServeSpec(CFG, n_slots=2, max_len=16, kv_layout="paged",
+                        block_size=4, prefill_chunk=4)
+    report = lint_serve(stages, chunked)
+    assert report.ok()
+    unbounded_rules = [f for f in report.findings
+                       if f.rule == "retrace-explosion.unbounded-trace-key"]
+    assert [f.where for f in unbounded_rules] == ["make_cached_decoder"]
+
+
+def test_hostlint_clean_and_pinned_to_gpt():
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        DECODE_BUILDER_NAMES,
+        lint_repo,
+    )
+    assert set(DECODE_BUILDER_NAMES) == set(DECODE_BUILDERS)
+    report = lint_repo()
+    assert report.ok(fail_on="warning"), report.format()
+
+
+def test_hostlint_flags_bypass_and_unmemoized(tmp_path):
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        _lint_call_sites,
+        lint_builder_definitions,
+    )
+    bad = tmp_path / "bad_site.py"
+    bad.write_text(
+        "import jax\n"
+        "from simple_distributed_machine_learning_tpu.models.gpt import (\n"
+        "    _build_cached_decoder, _DECODE_BUILD_CACHE)\n"
+        "dec = _build_cached_decoder(8, 4, 4, 2, 8, None, 0.0, None, None)\n"
+        "_DECODE_BUILD_CACHE.clear()\n"
+        "step = jax.jit(lambda x: x)\n")
+    rules = {f.rule for f in _lint_call_sites(str(bad), allow_jit=False)}
+    assert {"hostlint.builder-bypass", "hostlint.cache-poke",
+            "hostlint.raw-jit-in-serve"} <= rules
+    # every other spelling of a raw jit must be caught too — aliased
+    # module, from-import, renamed from-import, pjit
+    for src in ("from jax import jit\nstep = jit(lambda x: x)\n",
+                "from jax import jit as q\nstep = q(lambda x: x)\n",
+                "import jax as j\nstep = j.jit(lambda x: x)\n",
+                "from jax.experimental.pjit import pjit\n"
+                "step = pjit(lambda x: x)\n"):
+        aliased = tmp_path / "aliased_site.py"
+        aliased.write_text(src)
+        got = {f.rule for f in _lint_call_sites(str(aliased),
+                                                allow_jit=False)}
+        assert "hostlint.raw-jit-in-serve" in got, src
+    # a gpt.py whose builder dropped the memo
+    fake_gpt = tmp_path / "gpt.py"
+    fake_gpt.write_text(
+        "def make_cached_decoder(stages, cfg):\n"
+        "    import jax\n"
+        "    return jax.jit(lambda p: p)\n")
+    findings = lint_builder_definitions(str(fake_gpt))
+    assert any(f.rule == "hostlint.unmemoized-builder" for f in findings)
+
+
+def test_hostlint_cli_exit_codes():
+    from simple_distributed_machine_learning_tpu.analysis.__main__ import (
+        main,
+    )
+    assert main(["--hostlint"]) == 0
+
+
+def test_hostlint_runs_without_jax():
+    """The AST lint's reason to exist is running when jax is broken or
+    absent (the CI hostlint step sets no backend): importing and running
+    it must not pull jax through the package __init__ chain. Simulated by
+    purging jax from sys.modules and blocking any re-import."""
+    import subprocess
+    import sys
+
+    prog = (
+        "import sys\n"
+        "for m in [k for k in sys.modules"
+        " if k == 'jax' or k.startswith(('jax.', 'jaxlib'))]:\n"
+        "    del sys.modules[m]\n"
+        "class B:\n"  # find_spec: the one meta-path hook every
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith(('jax.', 'jaxlib')):\n"
+        "            raise ImportError('blocked: ' + name)\n"
+        "sys.meta_path.insert(0, B())\n"
+        "try:\n"           # the blocker must itself work on this python,
+        "    import jax\n"  # or the test is vacuous
+        "except ImportError:\n"
+        "    pass\n"
+        "else:\n"
+        "    print('BLOCKER INERT'); sys.exit(3)\n"
+        "from simple_distributed_machine_learning_tpu.analysis.__main__ "
+        "import main\n"
+        "sys.exit(main(['--hostlint']))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", prog],
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+# ---- 4. HBM model vs the live pool's gauge -------------------------------
+
+@pytest.mark.parametrize("block_size,n_reqs,prompts", [
+    (4, 2, (5, 9)),
+    (8, 3, (4, 6, 9)),
+    (4, 3, (3, 3, 11)),
+])
+def test_predicted_resident_bytes_match_gauge(stages, block_size, n_reqs,
+                                              prompts):
+    from simple_distributed_machine_learning_tpu.serve import (
+        InferenceEngine,
+    )
+    rng = np.random.default_rng(3)
+    ml = 20
+    engine = InferenceEngine(stages, CFG, n_slots=n_reqs, max_len=ml,
+                             block_size=block_size)
+    handles = []
+    for i, plen in enumerate(prompts):
+        # distinct first tokens: no prefix sharing, so the no-sharing
+        # model is exact
+        prompt = rng.integers(0, CFG.vocab, plen).astype(np.int32)
+        prompt[0] = i
+        handles.append(engine.submit(prompt, max_new_tokens=6, seed=i))
+    sspec = ServeSpec(CFG, n_slots=n_reqs, max_len=ml, kv_layout="paged",
+                      block_size=block_size)
+    for _ in range(n_reqs + 2):      # prefills (one per tick) + decodes
+        engine.step()
+        rows = []
+        for h in handles:
+            if h.state != "active":
+                continue
+            if h.prefill_pos is not None:        # mid-prefill
+                rows.append(h.prefill_pos)
+            else:
+                rows.append(int(h.prompt.shape[0]) + len(h.tokens) - 1)
+        predicted = predict_kv_bytes_resident(sspec,
+                                              [r for r in rows if r > 0])
+        assert predicted == engine.pool.stats()["kv_bytes_resident"], (
+            block_size, rows, engine.pool.stats())
+    assert engine.pool.stats()["kv_bytes_resident"] > 0
+    # the static per-tick model agrees with the pool's block geometry
+    gather = next(h for h in hbm_tick_costs(sspec)
+                  if h.op == "decode.kv_gather")
+    span = -(-ml // block_size) * block_size
+    assert gather.bytes_per_tick == (
+        n_reqs * engine.pool.bytes_per_block * span // block_size)
+
+
+# ---- engine + CLI wiring -------------------------------------------------
+
+def test_engine_lint_true_constructs_and_gates(stages, monkeypatch):
+    from simple_distributed_machine_learning_tpu.serve import (
+        InferenceEngine,
+    )
+    eng = InferenceEngine(stages, CFG, n_slots=2, max_len=16, block_size=4,
+                          prefill_chunk=3, lint=True)
+    assert lint_engine(eng, prompt_lens=BUCKETS).ok()
+    monkeypatch.setenv("SDML_LINT_INJECT", "unit")
+    with pytest.raises(RuntimeError, match="preflight found ERROR"):
+        InferenceEngine(stages, CFG, n_slots=2, max_len=16, block_size=4,
+                        prefill_chunk=3, lint=True)
+
+
+def test_serve_cli_gate_exit_codes(monkeypatch):
+    from simple_distributed_machine_learning_tpu.analysis.__main__ import (
+        main,
+    )
+    assert main(["--serve"]) == 0
+    monkeypatch.setenv("SDML_LINT_INJECT", "unit")
+    assert main(["--serve"]) == 1
+
+
+# ---- bounds arithmetic unit checks ---------------------------------------
+
+def test_interval_arithmetic_corners():
+    assert _floordiv_iv(Interval(-5, 11), Interval(4, 4)) == Interval(-2, 2)
+    assert _mod_iv(Interval(-5, 11), Interval(4, 4)) == Interval(0, 3)
+    assert _cmp_iv("lt", Interval(0, 3), Interval(4, 9)) == Interval(1, 1)
+    assert _cmp_iv("lt", Interval(4, 9), Interval(0, 4)) == Interval(0, 0)
+    assert _cmp_iv("lt", Interval(0, 5), Interval(3, 4)) == Interval(0, 1)
+    assert _cmp_iv("ge", Interval(0, 5), Interval(0, 0)) == Interval(1, 1)
+
+
+def test_narrowing_cast_drops_the_proof():
+    # int32 -> int8 WRAPS at runtime for values past 127: the declared
+    # interval must not survive the cast and falsely certify a PROMISE
+    # gather — a fitting cast keeps the proof
+    def f(x, i):
+        j = jax.lax.convert_element_type(i, np.int8)
+        return x.at[j].get(mode="promise_in_bounds")
+
+    x = jax.ShapeDtypeStruct((100,), np.float32)
+    wrapping = analyze(f, x, spec((), np.int32, 0, 200))
+    assert any(f_.rule == "scatter-bounds.unproven-promise"
+               for f_ in wrapping.findings), wrapping.format()
+    fitting = analyze(f, x, spec((), np.int32, 0, 90))
+    assert fitting.ok(fail_on="warning"), fitting.format()
+
+
+def test_bounds_prove_simple_program():
+    def f(table, idx):
+        return table[idx // 4]
+
+    t = spec((3,), np.int32, 0, 2)
+    good = analyze(f, t, spec((3,), np.int32, 0, 11))
+    assert good.ok(fail_on="warning"), good.format()
+    bad = analyze(f, t, spec((3,), np.int32, 0, 12))
+    assert any(f_.rule == "scatter-bounds.out-of-range"
+               for f_ in bad.findings), bad.format()
+
+
+def test_half_declared_contract_degrades_to_unproven():
+    """A one-sided spec (only ``lo`` or only ``hi``) proves nothing about
+    the unbounded side, so it must get the same not-proven treatment as no
+    contract at all — a WARNING at worst, never a gating ERROR. A finite
+    bound that puts the WHOLE interval outside the operand is still a
+    provable violation."""
+    def f(x, i):
+        return x[i]
+
+    x = jax.ShapeDtypeStruct((4, 8), np.float32)
+    half = analyze(f, x, spec((3,), np.int32, lo=0))
+    assert half.ok(), half.format()
+    assert any(f_.rule == "scatter-bounds.unproven-promise"
+               for f_ in half.findings), half.format()
+    # lo=10 into a 4-row operand: every possible value is out of range,
+    # provable even though hi is unbounded
+    beyond = analyze(f, x, spec((3,), np.int32, lo=10))
+    assert any(f_.rule == "scatter-bounds.out-of-range"
+               for f_ in beyond.findings), beyond.format()
+
+
+def test_scatter_variant_primitives_checked():
+    """``.at[].min()``/``.at[].max()`` lower to scatter-min/scatter-max
+    (hyphenated primitive names) — they must hit the same bounds check as
+    plain scatter, not fall through to the generic unknown handler."""
+    x = jax.ShapeDtypeStruct((4,), np.float32)
+    for op in ("min", "max"):
+        def f(x, i, _op=op):
+            return getattr(x.at[i], _op)(3.0)
+
+        bad = analyze(f, x, spec((), np.int32, 0, 9))
+        assert any(f_.rule == "scatter-bounds.out-of-range"
+                   for f_ in bad.findings), (op, bad.format())
+        good = analyze(f, x, spec((), np.int32, 0, 3))
+        assert good.ok(fail_on="warning"), (op, good.format())
