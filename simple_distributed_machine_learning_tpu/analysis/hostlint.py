@@ -45,6 +45,11 @@ DECODE_BUILDER_NAMES = (
     "make_paged_prefill_chunk",
     "make_paged_decode_step",
     "make_paged_block_copy",
+    "make_slot_propose",
+    "make_slot_verify_step",
+    "make_paged_verify_step",
+    "make_slot_spec_tick",
+    "make_paged_spec_tick",
 )
 
 _PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -35,6 +35,15 @@ What runs per program:
   :func:`predict_kv_bytes_resident`, cross-checked against the pool's
   ``serve_kv_bytes_resident`` gauge in tests.
 
+Since ISSUE 9 the registry also covers sharded + speculative serving: with
+``cfg.n_tensor_parallel > 1`` (pass the live ``mesh``) every serving
+program is rebuilt as its exact ``shard_map`` twin — head-sharded pool,
+packed Megatron weights — and the mesh-axis + scatter-bounds rules walk
+the sharded block gathers; with ``spec_k >= 2`` (pass the draft build) the
+draft propose scan, the batched verify step and a composite speculative
+tick join the registry, and the HBM model reports PER-SHARD bytes plus the
+verify/propose streams.
+
 Entry points::
 
     spec = ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=16,
@@ -76,7 +85,13 @@ class ServeSpec:
     ``prompt_lens`` declares the deployment's prompt-length buckets (the
     simulator's ``GPT_SERVE_PROMPTS``, a real frontend's bucketing): the
     retrace-explosion rule treats a prompt-shaped trace key as bounded iff
-    buckets are declared or chunked prefill bounds the shapes."""
+    buckets are declared or chunked prefill bounds the shapes.
+
+    Tensor parallelism rides in ``cfg.n_tensor_parallel`` (the engine's
+    own knob — :attr:`tp` reads it); ``lint_serve`` then needs the live
+    ``mesh`` to rebuild the sharded programs. ``spec_k``/``draft_cfg``
+    declare speculative decoding (``lint_serve`` additionally needs the
+    ``draft_stages`` build to trace the propose/verify pair)."""
     cfg: Any
     n_slots: int = 4
     max_len: int | None = None          # None -> cfg.seq_len
@@ -86,6 +101,14 @@ class ServeSpec:
     prefill_chunk: int | None = None
     cache_dtype: Any = None
     prompt_lens: tuple | None = None
+    spec_k: int = 0                     # 0 -> plain decode (no draft)
+    draft_cfg: Any = None
+
+    @property
+    def tp(self) -> int:
+        """Tensor-parallel width — the cfg's own knob, surfaced so the
+        HBM model and per-shard byte accounting read one source."""
+        return int(getattr(self.cfg, "n_tensor_parallel", 1))
 
     @property
     def ml(self) -> int:
@@ -176,11 +199,17 @@ def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
 
-def build_registry(stages, sspec: ServeSpec
+def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
                    ) -> tuple[list[Program], list[Finding]]:
     """Build every compiled program of ``sspec``'s serve path with its
     abstract args + contracts; returns (programs, policy findings) where
-    the findings are the retrace/memo checks that are not jaxpr rules."""
+    the findings are the retrace/memo checks that are not jaxpr rules.
+
+    With ``sspec.tp > 1`` pass the live ``mesh`` — the registry then
+    builds the EXACT shard_map programs a TP engine runs (head-sharded
+    pool, packed Megatron weights). With ``sspec.spec_k >= 2`` pass the
+    ``draft_stages`` build — the draft propose scan, the batched verify
+    and a composite speculative tick join the registry."""
     import numpy as np
 
     from simple_distributed_machine_learning_tpu.models.gpt import (
@@ -191,6 +220,7 @@ def build_registry(stages, sspec: ServeSpec
         make_paged_prefill_chunk,
         make_slot_decode_step,
         make_slot_prefill,
+        pack_tp_serve_params,
     )
 
     cfg = sspec.cfg
@@ -202,7 +232,13 @@ def build_registry(stages, sspec: ServeSpec
     NB = sspec.blocks_per_seq
     n_blocks = sspec.nb
     cd = _cache_dtype(sspec.cache_dtype)
-    params = abstractify([s.params for s in stages])
+    dense_params = [s.params for s in stages]
+    if sspec.tp > 1:
+        # the TP serving layout: stacked Megatron block slices + replicated
+        # embed/head (what the engine actually feeds the shard_map programs)
+        params = abstractify(pack_tp_serve_params(dense_params, sspec.tp))
+    else:
+        params = abstractify(dense_params)
 
     f32 = _sds((), np.float32)
     f32S = _sds((S,), np.float32)
@@ -217,33 +253,65 @@ def build_registry(stages, sspec: ServeSpec
     findings: list[Finding] = []
 
     # the cached decoder: the solo-parity anchor every served request is
-    # bit-exact against — linted at one representative bucket
+    # bit-exact against — linted at one representative bucket (always the
+    # dense single-device build, whatever the serving layout/tp)
     t0 = int(min(sspec.prompt_lens)) if sspec.prompt_lens else min(4, ml - 1)
     t0 = max(1, min(t0, ml - 1))
     n_new = ml - t0
     findings += check_builder_memo(
         "make_cached_decoder",
-        lambda: make_cached_decoder(stages, cfg, t0, n_new,
+        lambda: make_cached_decoder(stages, cfg_dense(cfg), t0, n_new,
                                     cache_dtype=sspec.cache_dtype))
     findings += _retrace_finding("make_cached_decoder",
                                  "(prompt_len, n_new) pair", sspec)
     programs.append(Program(
         "cached_decoder",
-        make_cached_decoder(stages, cfg, t0, n_new,
+        make_cached_decoder(stages, cfg_dense(cfg), t0, n_new,
                             cache_dtype=sspec.cache_dtype),
-        (params, spec((1, t0), np.int32, 0, V - 1), _key_sds())))
+        (abstractify(dense_params), spec((1, t0), np.int32, 0, V - 1),
+         _key_sds())))
+
+    K = int(sspec.spec_k)
+    speculative = K >= 2 and draft_stages is not None
+    valid_n = spec((S,), np.int32, 0, K) if speculative else None
+    drafts_a = spec((S, K), np.int32, 0, V - 1) if speculative else None
+    qrows_a = _sds((S, K, V), np.float32) if speculative else None
+
+    def _spec_draft_programs():
+        """The draft propose scan + its abstract pool (dense slot layout
+        whatever the target layout — the engine's draft discipline)."""
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            make_slot_propose,
+        )
+        dcfg = sspec.draft_cfg
+        dL = sum(len(p["blocks"]) for p in (s.params for s in draft_stages))
+        dkc = _sds((dL, S, dcfg.n_heads, ml,
+                    dcfg.d_model // dcfg.n_heads), cd)
+        propose = make_slot_propose(draft_stages, dcfg, ml, K,
+                                    sspec.cache_dtype)
+        memo = check_builder_memo(
+            "make_slot_propose",
+            lambda: make_slot_propose(draft_stages, dcfg, ml, K,
+                                      sspec.cache_dtype))
+        dparams = abstractify([s.params for s in draft_stages])
+        propose_args = (dparams, dkc, dkc, toks, pos, kdS, f32S, top_ks,
+                        f32S)
+        return propose, propose_args, memo
 
     if sspec.kv_layout == "dense":
         kc = _sds((L, S, H, ml, dh), cd)
-        prefill = make_slot_prefill(stages, cfg, ml, sspec.cache_dtype)
-        decode = make_slot_decode_step(stages, cfg, ml, sspec.cache_dtype)
+        prefill = make_slot_prefill(stages, cfg, ml, sspec.cache_dtype,
+                                    mesh=mesh)
+        decode = make_slot_decode_step(stages, cfg, ml, sspec.cache_dtype,
+                                       mesh=mesh)
         findings += check_builder_memo(
             "make_slot_prefill",
-            lambda: make_slot_prefill(stages, cfg, ml, sspec.cache_dtype))
+            lambda: make_slot_prefill(stages, cfg, ml, sspec.cache_dtype,
+                                      mesh=mesh))
         findings += check_builder_memo(
             "make_slot_decode_step",
             lambda: make_slot_decode_step(stages, cfg, ml,
-                                          sspec.cache_dtype))
+                                          sspec.cache_dtype, mesh=mesh))
         findings += _retrace_finding("make_slot_prefill", "prompt length",
                                      sspec)
         t0p = t0
@@ -268,6 +336,60 @@ def build_registry(stages, sspec: ServeSpec
             "dense_tick", dense_tick,
             prefill_args[:1] + (kc, kc) + prefill_args[3:]
             + decode_args[3:]))
+
+        if speculative:
+            from simple_distributed_machine_learning_tpu.models.gpt import (
+                make_slot_verify_step,
+            )
+            propose, propose_args, memo = _spec_draft_programs()
+            findings += memo
+            verify = make_slot_verify_step(stages, cfg, ml, K,
+                                           sspec.cache_dtype, mesh=mesh)
+            findings += check_builder_memo(
+                "make_slot_verify_step",
+                lambda: make_slot_verify_step(stages, cfg, ml, K,
+                                              sspec.cache_dtype,
+                                              mesh=mesh))
+            verify_args = (params, kc, kc, toks, pos, drafts_a, qrows_a,
+                           valid_n, kdS, f32S, top_ks, f32S)
+            programs.append(Program("slot_propose", propose, propose_args))
+            programs.append(Program("slot_verify", verify, verify_args))
+
+            # the composite speculative tick: propose (draft pool) ->
+            # verify (target pool), proposals flowing between on device.
+            # Single-device targets execute this as the engine's FUSED
+            # make_slot_spec_tick program — lint exactly that build; a TP
+            # engine dispatches the two halves separately, so the closure
+            # composition below IS its tick
+            if sspec.tp == 1:
+                from simple_distributed_machine_learning_tpu.models.gpt import (  # noqa: E501
+                    make_slot_spec_tick,
+                )
+                dcfg = sspec.draft_cfg
+                dense_spec_tick = make_slot_spec_tick(
+                    stages, cfg, draft_stages, dcfg, ml, K,
+                    sspec.cache_dtype)
+                findings += check_builder_memo(
+                    "make_slot_spec_tick",
+                    lambda: make_slot_spec_tick(stages, cfg, draft_stages,
+                                                dcfg, ml, K,
+                                                sspec.cache_dtype))
+            else:
+                def dense_spec_tick(dparams, dkc, dvc, params, kc, vc,
+                                    toks, pos, valid, dkds, kds, temps,
+                                    tks, tps):
+                    dkc, dvc, drafts, qrows, dkds2 = propose(
+                        dparams, dkc, dvc, toks, pos, dkds, temps, tks,
+                        tps)
+                    kc, vc, toks2, n_acc, kds2 = verify(
+                        params, kc, vc, toks, pos, drafts, qrows, valid,
+                        kds, temps, tks, tps)
+                    return dkc, dvc, kc, vc, toks2, n_acc, kds2, dkds2
+
+            programs.append(Program(
+                "dense_spec_tick", dense_spec_tick,
+                propose_args[:3] + (params, kc, kc, toks, pos, valid_n,
+                                    kdS, kdS, f32S, top_ks, f32S)))
         return programs, findings
 
     # paged layout
@@ -275,17 +397,19 @@ def build_registry(stages, sspec: ServeSpec
     tables = spec((S, NB), np.int32, 0, n_blocks)
     table1 = spec((NB,), np.int32, 0, n_blocks)
     c = sspec.resolved_chunk
-    chunk = make_paged_prefill_chunk(stages, cfg, ml, bs, sspec.cache_dtype)
-    decode = make_paged_decode_step(stages, cfg, ml, bs, sspec.cache_dtype)
+    chunk = make_paged_prefill_chunk(stages, cfg, ml, bs,
+                                     sspec.cache_dtype, mesh=mesh)
+    decode = make_paged_decode_step(stages, cfg, ml, bs,
+                                    sspec.cache_dtype, mesh=mesh)
     copy = make_paged_block_copy()
     findings += check_builder_memo(
         "make_paged_prefill_chunk",
         lambda: make_paged_prefill_chunk(stages, cfg, ml, bs,
-                                         sspec.cache_dtype))
+                                         sspec.cache_dtype, mesh=mesh))
     findings += check_builder_memo(
         "make_paged_decode_step",
         lambda: make_paged_decode_step(stages, cfg, ml, bs,
-                                       sspec.cache_dtype))
+                                       sspec.cache_dtype, mesh=mesh))
     findings += check_builder_memo("make_paged_block_copy",
                                    make_paged_block_copy)
     if sspec.prefill_chunk is None:
@@ -320,7 +444,64 @@ def build_registry(stages, sspec: ServeSpec
         "paged_tick", paged_tick,
         chunk_args[:1] + (kc, kc) + chunk_args[3:] + copy_args[2:]
         + decode_args[3:]))
+
+    if speculative:
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            make_paged_verify_step,
+        )
+        propose, propose_args, memo = _spec_draft_programs()
+        findings += memo
+        verify = make_paged_verify_step(stages, cfg, ml, bs, K,
+                                        sspec.cache_dtype, mesh=mesh)
+        findings += check_builder_memo(
+            "make_paged_verify_step",
+            lambda: make_paged_verify_step(stages, cfg, ml, bs, K,
+                                           sspec.cache_dtype, mesh=mesh))
+        verify_args = (params, kc, kc, toks, pos, drafts_a, qrows_a,
+                       valid_n, tables, kdS, f32S, top_ks, f32S)
+        programs.append(Program("paged_propose", propose, propose_args))
+        programs.append(Program("paged_verify", verify, verify_args))
+
+        # single-device targets run the engine's FUSED make_paged_spec_tick
+        # build; a TP engine dispatches the two halves separately (see the
+        # dense branch's note)
+        if sspec.tp == 1:
+            from simple_distributed_machine_learning_tpu.models.gpt import (
+                make_paged_spec_tick,
+            )
+            dcfg = sspec.draft_cfg
+            paged_spec_tick = make_paged_spec_tick(
+                stages, cfg, draft_stages, dcfg, ml, bs, K,
+                sspec.cache_dtype)
+            findings += check_builder_memo(
+                "make_paged_spec_tick",
+                lambda: make_paged_spec_tick(stages, cfg, draft_stages,
+                                             dcfg, ml, bs, K,
+                                             sspec.cache_dtype))
+        else:
+            def paged_spec_tick(dparams, dkc, dvc, params, kc, vc, toks,
+                                pos, valid, tables, dkds, kds, temps, tks,
+                                tps):
+                dkc, dvc, drafts, qrows, dkds2 = propose(
+                    dparams, dkc, dvc, toks, pos, dkds, temps, tks, tps)
+                kc, vc, toks2, n_acc, kds2 = verify(
+                    params, kc, vc, toks, pos, drafts, qrows, valid,
+                    tables, kds, temps, tks, tps)
+                return dkc, dvc, kc, vc, toks2, n_acc, kds2, dkds2
+
+        programs.append(Program(
+            "paged_spec_tick", paged_spec_tick,
+            propose_args[:3] + (params, kc, kc, toks, pos, valid_n,
+                                tables, kdS, kdS, f32S, top_ks, f32S)))
     return programs, findings
+
+
+def cfg_dense(cfg):
+    """The single-device twin of a (possibly TP) serving config — what the
+    solo-parity anchor decodes with."""
+    if getattr(cfg, "n_tensor_parallel", 1) == 1:
+        return cfg
+    return dataclasses.replace(cfg, n_tensor_parallel=1)
 
 
 # -- the HBM-bytes-per-tick model ------------------------------------------
@@ -344,34 +525,65 @@ def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
     H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
     isz = np.dtype(_cache_dtype(sspec.cache_dtype)).itemsize
     S, ml = sspec.n_slots, sspec.ml
-    row = 2 * H * dh * isz                      # K + V, one position, 1 layer
+    tp = sspec.tp
+    # K + V, one position, 1 layer — PER SHARD (the TP serving programs
+    # split the head axis tp ways, so each chip streams H/tp heads; this
+    # is the same per-shard rule the pool's bytes_per_block uses)
+    row = 2 * (H // tp) * dh * isz
+    shard = f" (per {tp}-way shard)" if tp > 1 else ""
     out: list[HBMCost] = []
+    K = int(sspec.spec_k)
     if sspec.kv_layout == "paged":
         span = sspec.blocks_per_seq * sspec.block_size
         out.append(HBMCost(
             "decode.kv_gather", "paged_decode", S * L * span * row,
-            note=f"{S} slots x {L} layers x {span}-row table span"))
+            note=f"{S} slots x {L} layers x {span}-row table span{shard}"))
         out.append(HBMCost(
             "decode.kv_scatter", "paged_decode", S * L * row,
-            note="one position per slot per layer"))
+            note=f"one position per slot per layer{shard}"))
         c = sspec.resolved_chunk
         out.append(HBMCost(
             "prefill.kv_scatter", "paged_prefill_chunk", c * L * row,
-            note=f"{c}-token chunk"))
+            note=f"{c}-token chunk{shard}"))
         out.append(HBMCost(
             "prefill.kv_gather", "paged_prefill_chunk", L * span * row,
-            note="the chunk attends the gathered table span"))
+            note=f"the chunk attends the gathered table span{shard}"))
         out.append(HBMCost(
             "cow.block_copy", "paged_block_copy",
             L * sspec.block_size * row,
-            note="per copy-on-write divergence, all layers"))
+            note=f"per copy-on-write divergence, all layers{shard}"))
+        if K >= 2:
+            out.append(HBMCost(
+                "verify.kv_scatter", "paged_verify", S * L * K * row,
+                note=f"{K} speculated positions per slot per layer{shard}"))
+            out.append(HBMCost(
+                "verify.kv_gather", "paged_verify", S * L * span * row,
+                note=f"the verify queries attend the table span{shard}"))
     else:
         out.append(HBMCost(
             "decode.kv_read", "slot_decode", S * L * ml * row,
-            note=f"{S} rows x {L} layers x max_len={ml}"))
+            note=f"{S} rows x {L} layers x max_len={ml}{shard}"))
         out.append(HBMCost(
             "decode.kv_scatter", "slot_decode", S * L * row,
-            note="one position per slot per layer"))
+            note=f"one position per slot per layer{shard}"))
+        if K >= 2:
+            out.append(HBMCost(
+                "verify.kv_scatter", "slot_verify", S * L * K * row,
+                note=f"{K} speculated positions per slot per layer{shard}"))
+            out.append(HBMCost(
+                "verify.kv_read", "slot_verify", S * L * ml * row,
+                note=f"the verify queries read the full rows{shard}"))
+    if K >= 2 and sspec.draft_cfg is not None:
+        dcfg = sspec.draft_cfg
+        drow = 2 * dcfg.n_heads * (dcfg.d_model // dcfg.n_heads) * isz
+        dL = dcfg.n_layers
+        out.append(HBMCost(
+            "propose.kv_read", "slot_propose", K * S * dL * ml * drow,
+            note=f"{K} draft steps x {S} rows x {dL} draft layers x "
+                 f"max_len={ml} (replicated draft)"))
+        out.append(HBMCost(
+            "propose.kv_scatter", "slot_propose", K * S * dL * drow,
+            note="one position per draft step per slot per draft layer"))
     return out
 
 
@@ -382,13 +594,15 @@ def predict_kv_bytes_resident(sspec: ServeSpec, rows_per_seq,
     sequence's written-row count (``prompt_len + tokens_emitted - 1`` once
     decoding). Assumes no prefix sharing between the sequences — shared
     blocks make the true gauge strictly smaller. Paged layout only (the
-    dense pool pins everything up front)."""
+    dense pool pins everything up front). PER SHARD under TP — the pool's
+    gauge reports per-chip bytes (heads split ``tp`` ways), and this model
+    must agree with it EXACTLY (tests/test_analysis_serve.py)."""
     from simple_distributed_machine_learning_tpu.serve.slots import (
         kv_block_bytes,
     )
     cfg = sspec.cfg
     L = n_layers if n_layers is not None else cfg.n_layers
-    per_block = kv_block_bytes(L, cfg.n_heads, sspec.block_size,
+    per_block = kv_block_bytes(L, cfg.n_heads // sspec.tp, sspec.block_size,
                                cfg.d_model // cfg.n_heads,
                                sspec.cache_dtype)
     blocks = sum(math.ceil(r / sspec.block_size) for r in rows_per_seq)
@@ -408,21 +622,37 @@ def _injected_findings() -> list[Finding]:
         where="SDML_LINT_INJECT", hint="unset SDML_LINT_INJECT")]
 
 
-def lint_serve(stages, sspec: ServeSpec, name: str | None = None) -> Report:
+def lint_serve(stages, sspec: ServeSpec, name: str | None = None,
+               mesh=None, draft_stages=None) -> Report:
     """Trace and lint every compiled program of one serving deployment;
     returns a single merged :class:`Report` carrying the findings of all
     rule families, the retrace/memo policy checks and the
-    HBM-bytes-per-tick table."""
-    programs, policy = build_registry(stages, sspec)
+    HBM-bytes-per-tick table. Pass the live ``mesh`` for a TP deployment
+    (``sspec.tp > 1``) and the ``draft_stages`` build for a speculative
+    one (``sspec.spec_k >= 2``)."""
+    if sspec.tp > 1 and mesh is None:
+        raise ValueError(
+            f"lint_serve: sspec.cfg.n_tensor_parallel={sspec.tp} needs the "
+            f"deployment's mesh to rebuild the sharded programs")
+    if sspec.spec_k >= 2 and draft_stages is None:
+        raise ValueError(
+            f"lint_serve: sspec.spec_k={sspec.spec_k} needs the "
+            f"draft_stages build to trace the propose/verify pair")
+    programs, policy = build_registry(stages, sspec, mesh=mesh,
+                                      draft_stages=draft_stages)
     n_layers = sum(len(p["blocks"]) for p in (s.params for s in stages))
     label = name or (f"serve[{sspec.kv_layout} slots={sspec.n_slots} "
                      f"max_len={sspec.ml}"
                      + (f" block={sspec.block_size}"
                         f" chunk={sspec.prefill_chunk}"
-                        if sspec.kv_layout == "paged" else "") + "]")
+                        if sspec.kv_layout == "paged" else "")
+                     + (f" tp={sspec.tp}" if sspec.tp > 1 else "")
+                     + (f" spec_k={sspec.spec_k}" if sspec.spec_k
+                        else "") + "]")
     report = Report(name=label, findings=list(policy))
     for prog in programs:
-        sub = analyze(prog.fn, *prog.args, name=f"{label}:{prog.name}")
+        sub = analyze(prog.fn, *prog.args, mesh=mesh,
+                      name=f"{label}:{prog.name}")
         for f in sub.findings:
             report.findings.append(dataclasses.replace(
                 f, where=f"{prog.name}: {f.where}" if f.where
@@ -447,6 +677,9 @@ def default_registry_reports() -> list[Report]:
     )
     cfg = GPTConfig(vocab=32, seq_len=24, d_model=16, n_heads=2, n_layers=2)
     stages, _, _ = make_gpt_stages(jax.random.key(0), cfg, 1)
+    import dataclasses as _dc
+    draft_cfg = _dc.replace(cfg, n_layers=1)
+    draft_stages, _, _ = make_gpt_stages(jax.random.key(1), draft_cfg, 1)
     buckets = (4, 8, 12)
     specs = [
         ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
@@ -454,8 +687,18 @@ def default_registry_reports() -> list[Report]:
         ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=8,
                   prefill_chunk=None, prompt_lens=buckets),
         ServeSpec(cfg, n_slots=4, kv_layout="dense", prompt_lens=buckets),
+        # the speculative pair (draft propose + batched verify + composite
+        # tick) on both layouts — TP deployments need a live multi-device
+        # mesh, so the CLI/tests cover those where devices exist
+        ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
+                  prefill_chunk=3, prompt_lens=buckets, spec_k=4,
+                  draft_cfg=draft_cfg),
+        ServeSpec(cfg, n_slots=4, kv_layout="dense", prompt_lens=buckets,
+                  spec_k=4, draft_cfg=draft_cfg),
     ]
-    return [lint_serve(stages, s) for s in specs]
+    return [lint_serve(stages, s, draft_stages=(draft_stages
+                                                if s.spec_k else None))
+            for s in specs]
 
 
 def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:
@@ -471,5 +714,8 @@ def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:
         block_size=pool.block_size if paged else 16,
         n_blocks=pool.n_blocks if paged else None,
         prefill_chunk=engine.prefill_chunk,
-        cache_dtype=pool.kc.dtype, prompt_lens=prompt_lens)
-    return lint_serve(engine.stages, sspec)
+        cache_dtype=pool.kc.dtype, prompt_lens=prompt_lens,
+        spec_k=engine.spec_k if engine.speculative else 0,
+        draft_cfg=engine.draft_cfg)
+    return lint_serve(engine.stages, sspec, mesh=engine.mesh,
+                      draft_stages=engine.draft_stages)

@@ -187,6 +187,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "system-prompt case) — the paged pool serves the "
                         "prefix from shared physical blocks, copy-on-write "
                         "at divergence")
+    g.add_argument('--serve-tp', type=int, default=1, metavar="T",
+                   help="with --serve-sim: tensor-parallel width of the "
+                        "serving programs — every tick runs head-sharded "
+                        "QKV/O + collective-matmul MLP over T chips of the "
+                        "mesh's model axis and the K/V pool shards its "
+                        "head axis, so per-chip KV bytes drop by T "
+                        "(needs T devices; T must divide n_heads)")
+    g.add_argument('--serve-spec-k', type=int, default=0, metavar="K",
+                   help="with --serve-sim: speculative decoding — a small "
+                        "draft model (half the target's layers, fresh "
+                        "init) proposes K tokens per slot per tick and "
+                        "the target verifies all K in ONE batched step, "
+                        "emitting 1..K tokens; greedy streams stay "
+                        "bit-exact vs solo decode. 0 = plain one-token "
+                        "decode; K >= 2 enables the draft/verify tick")
     g.add_argument('--text-corpus', default=None, metavar="PATH",
                    help="for --model=gpt: train on the BYTES of this local "
                         "file (vocab=256, next-byte LM, contiguous "
@@ -667,7 +682,15 @@ def _run_serve(args, n_stages: int, key) -> None:
     if args.serve_shared_prefix < 0:
         raise SystemExit(f"--serve-shared-prefix must be >= 0, got "
                          f"{args.serve_shared_prefix}")
+    if args.serve_tp < 1:
+        raise SystemExit(f"--serve-tp must be >= 1, got {args.serve_tp}")
+    if args.serve_spec_k == 1 or args.serve_spec_k < 0:
+        raise SystemExit(f"--serve-spec-k must be 0 (plain decode) or "
+                         f">= 2, got {args.serve_spec_k}")
     cfg = GPTConfig(vocab=256 if args.text_corpus else 128)
+    if cfg.n_heads % args.serve_tp:
+        raise SystemExit(f"--serve-tp {args.serve_tp} must divide the "
+                         f"model's head count ({cfg.n_heads})")
     longest = args.serve_shared_prefix + max(GPT_SERVE_PROMPTS)
     if longest + 1 > cfg.seq_len:
         raise SystemExit(
@@ -676,6 +699,39 @@ def _run_serve(args, n_stages: int, key) -> None:
             f"({max(GPT_SERVE_PROMPTS)}) + 1 token must fit seq_len "
             f"{cfg.seq_len}")
     stages, wire_dim, out_shape = make_gpt_stages(key, cfg, n_stages)
+    # the serving deployment shape: stages stay the dense unsharded build
+    # (the engine slices per shard itself), the serve cfg carries the TP
+    # width and the mesh binds the model axis the shard_map programs need
+    serve_cfg = cfg
+    mesh = None
+    if args.serve_tp > 1:
+        import dataclasses as _dc
+
+        import jax as _jax
+
+        from simple_distributed_machine_learning_tpu.parallel.mesh import (
+            make_mesh,
+        )
+        if len(_jax.devices()) < args.serve_tp:
+            raise SystemExit(
+                f"--serve-tp {args.serve_tp} needs {args.serve_tp} "
+                f"devices, have {len(_jax.devices())} (on CPU: "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{args.serve_tp})")
+        serve_cfg = _dc.replace(cfg, n_tensor_parallel=args.serve_tp)
+        mesh = make_mesh(n_stages=1, n_data=1, n_model=args.serve_tp)
+    draft_stages = draft_cfg = None
+    if args.serve_spec_k:
+        # the draft: same config family at half the layers, fresh init off
+        # a folded key — proposals only steer which tokens get verified,
+        # so an untrained draft costs acceptance rate, never correctness
+        import dataclasses as _dc
+
+        import jax as _jax
+        draft_cfg = _dc.replace(cfg,
+                                n_layers=max(1, cfg.n_layers // 2))
+        draft_stages, _dw, _do = make_gpt_stages(
+            _jax.random.fold_in(key, 1), draft_cfg, 1)
     if args.lint or args.lint_only:
         # the serve-path preflight gate: trace and lint the EXACT compiled
         # programs the ticks below will execute (block/position contracts
@@ -690,10 +746,11 @@ def _run_serve(args, n_stages: int, key) -> None:
         buckets = tuple(args.serve_shared_prefix + p
                         for p in GPT_SERVE_PROMPTS)
         report = lint_serve(stages, ServeSpec(
-            cfg, n_slots=args.serve_slots, kv_layout="paged",
+            serve_cfg, n_slots=args.serve_slots, kv_layout="paged",
             block_size=args.serve_block_size,
             prefill_chunk=(args.serve_prefill_chunk or None),
-            prompt_lens=buckets))
+            prompt_lens=buckets, spec_k=args.serve_spec_k,
+            draft_cfg=draft_cfg), mesh=mesh, draft_stages=draft_stages)
         print(report.format(costs=True))
         if not report.ok():
             raise SystemExit(2)
@@ -726,10 +783,11 @@ def _run_serve(args, n_stages: int, key) -> None:
               + (f" (no checkpoint at {ckpt})" if ckpt else ""))
     metrics = ServeMetrics(outdir=args.telemetry_dir)
     engine = InferenceEngine(
-        stages, cfg, params=params, n_slots=args.serve_slots,
+        stages, serve_cfg, params=params, n_slots=args.serve_slots,
         block_size=args.serve_block_size,
         prefill_chunk=(args.serve_prefill_chunk or None),
-        metrics=metrics)
+        metrics=metrics, mesh=mesh, draft_stages=draft_stages,
+        draft_cfg=draft_cfg, spec_k=args.serve_spec_k)
     max_new = min(args.serve_max_new, cfg.seq_len - longest)
     if max_new < args.serve_max_new:
         print(f"| serve: --serve-max-new {args.serve_max_new} clamped to "
@@ -754,6 +812,13 @@ def _run_serve(args, n_stages: int, key) -> None:
           f"{s['cow_copies']} CoW copies, "
           f"prefill chunk p50/p95 {s['prefill_chunk_ms_p50']}/"
           f"{s['prefill_chunk_ms_p95']} ms")
+    if args.serve_tp > 1 or args.serve_spec_k:
+        spec = (f", spec_k {s.get('spec_k', 0)} accept_rate "
+                f"{s.get('spec_accept_rate')} "
+                f"({s.get('spec_accepted_tokens', 0)}/"
+                f"{s.get('spec_proposed_tokens', 0)} draft tokens)"
+                if args.serve_spec_k else "")
+        print(f"| serve: tp {args.serve_tp}{spec}")
     if args.telemetry_dir:
         metrics.emit(extra={"rate": sim.rate, "n_slots": args.serve_slots,
                             "block_size": args.serve_block_size,

@@ -610,6 +610,136 @@ def _cache_dtype(cache_dtype):
     return jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
 
 
+# -- tensor-parallel serving ------------------------------------------------
+#
+# The serving builders below accept a GPTConfig with n_tensor_parallel > 1:
+# the same program math then runs inside shard_map over the mesh's "model"
+# axis with the training path's Megatron layout — QKV/O head-sharded
+# (_slice_tp_block slices the SAME dense weights, so a TP engine serves the
+# identical model the dense build trains and solo-decodes), the MLP as the
+# column→row collective pair of tensor.tp_pair_apply (overlap='ring'|'none'
+# knob included), and the K/V pool sharded over its HEAD axis so per-chip
+# cache bytes drop by tp. Stages stay the UNSHARDED dense build — the
+# serving layer slices per shard itself (pack_tp_serve_params), which keeps
+# checkpoint restore and the solo-decode parity anchor on one weight set.
+
+
+def pack_tp_serve_params(params_list, tp: int):
+    """Slice dense per-stage trees into the TP serving layout:
+    ``([stacked per-layer block trees], {"embed": ..., "head": ...})`` —
+    leaf i of a stacked block tree is shard i's Megatron slice (leading
+    axis ``tp``, placed ``P('model')`` by the engine); embed and head are
+    replicated. The slices are exactly :func:`_slice_tp_block`'s, so a TP
+    engine serves the identical model."""
+    embed, blocks, head = _merged_stage_trees(params_list)
+    stacked = [jax.tree.map(lambda *ls: jnp.stack(ls),
+                            *[_slice_tp_block(bp, m, tp) for m in range(tp)])
+               for bp in blocks]
+    return stacked, {"embed": embed, "head": head}
+
+
+def _tp_local_trees(params):
+    """Inside the serving shard_map: this shard's block slices (the stacked
+    leading axis arrives split to size 1 by the ``P('model')`` in_spec) and
+    the replicated embed/head."""
+    stacked, rep = params
+    blocks = [jax.tree.map(lambda leaf: leaf[0], bp) for bp in stacked]
+    return blocks, rep["embed"], rep["head"]
+
+
+def _tp_attn_tail(bp, h, a, overlap="none"):
+    """TP twin of :func:`_dense_attn_tail` — call inside ``shard_map`` with
+    shard-sliced block params (``a`` holds the local ``H/tp`` heads). The
+    attention output projection is row-parallel (``wo`` rows are
+    head-aligned), closed by one ``lax.psum`` (``overlap='none'``) or the
+    chunked-psum ring of ``overlap.ring_psum``; the MLP is the training
+    path's column→row collective pair (``tensor.tp_pair_apply``, gelu).
+    Same numbers as the dense tail up to the all-reduce's summation split
+    (token-level parity is pinned in tests/test_serve.py)."""
+    from jax import lax
+
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        pvary_to,
+        vma_of,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.tensor import (
+        tp_pair_apply,
+    )
+
+    z = _merge_heads(a) @ bp["attn"]["wo"]
+    if overlap == "ring":
+        from simple_distributed_machine_learning_tpu.parallel.overlap import (
+            ring_psum,
+        )
+        red = ring_psum(z, MODEL_AXIS)
+    else:
+        red = lax.psum(z, MODEL_AXIS)
+    h = pvary_to(h, tuple(vma_of(red))) + red
+    hn2 = layer_norm(bp["ln2"], h)
+    return h + tp_pair_apply({"w1": bp["mlp_in"], "w2": bp["mlp_out"]}, hn2,
+                             activation=jax.nn.gelu, overlap=overlap)
+
+
+def _close_rows(rows):
+    """Re-replicate the sampling rows across the model axis before any
+    token is drawn. With ``overlap='none'`` the replicas are already
+    bit-identical (psum is symmetric) and the pmean is the exact identity
+    for power-of-two tp (``(x * tp) / tp`` is exact in binary floating
+    point); with the ring schedule each shard's accumulation ORDER differs
+    by a ulp, and sampling on per-shard rows could argmax-diverge — the
+    pmean makes every shard sample the same row bits."""
+    from jax import lax
+
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+    )
+    return lax.pmean(rows, MODEL_AXIS)
+
+
+def _tp_jit(body, mesh, n_buf_in, n_rest_in, n_buf_out, n_rest_out,
+            donate=(1, 2)):
+    """``jit(shard_map(body))`` with the serving specs: params as the
+    ``(stacked blocks, replicated embed/head)`` pair, ``n_buf_in`` K/V pool
+    buffers sharded on their HEAD axis (dim 2 in both layouts), everything
+    else replicated. The pool buffers are donated exactly as in the
+    single-device builders."""
+    from jax.sharding import PartitionSpec as P
+
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        shard_map as _shard_map,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+    )
+    cache = P(None, None, MODEL_AXIS)
+    in_specs = (((P(MODEL_AXIS), P()),) + (cache,) * n_buf_in
+                + (P(),) * n_rest_in)
+    out_specs = (cache,) * n_buf_out + (P(),) * n_rest_out
+    fn = _shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return functools.partial(jax.jit, donate_argnums=donate)(fn)
+
+
+def _validate_tp_serve(cfg: GPTConfig, mesh, caller: str):
+    """Serving-op TP validation: ``n_tensor_parallel > 1`` needs a mesh
+    whose ``model`` axis is exactly that size (the shard_map programs bind
+    it); tp == 1 normalizes mesh to None so memo keys stay shared."""
+    tp = cfg.n_tensor_parallel
+    if tp == 1:
+        return None
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+    )
+    if mesh is None or dict(mesh.shape).get(MODEL_AXIS, 1) != tp:
+        got = None if mesh is None else dict(mesh.shape)
+        raise ValueError(
+            f"{caller}: cfg.n_tensor_parallel={tp} needs a mesh with a "
+            f"'{MODEL_AXIS}' axis of that size, got {got}")
+    return mesh
+
+
 # Built decode-path programs, keyed by their STATIC config. Every function
 # cached here closes over shape scalars only — params (and therefore the
 # stages' weights and layer count) arrive as traced ARGUMENTS — so two
@@ -975,7 +1105,7 @@ def _validate_slot_build(stages, cfg: GPTConfig, max_len: int,
 
 
 def make_slot_prefill(stages, cfg: GPTConfig, max_len: int,
-                      cache_dtype=None):
+                      cache_dtype=None, mesh=None):
     """Serving prefill-into-slot: ``prefill(params, kc, vc, prompt [1, T0],
     slot, key_data, temperature, top_k, top_p) -> (kc, vc, token,
     key_data)``.
@@ -995,43 +1125,86 @@ def make_slot_prefill(stages, cfg: GPTConfig, max_len: int,
     are DONATED — the engine always threads the returned buffers back into
     the pool, and donation lets XLA update the slot row in place instead of
     copying the whole pool per call.
+
+    With ``cfg.n_tensor_parallel > 1`` (pass the ``mesh``): the same math
+    inside ``shard_map`` — QKV on the local ``H/tp`` heads, K/V landing in
+    this shard's slice of the head-sharded pool, the attention/MLP reduces
+    of :func:`_tp_attn_tail` — with ``params`` in the
+    :func:`pack_tp_serve_params` layout.
     """
     _validate_slot_build(stages, cfg, max_len, "make_slot_prefill")
+    mesh = _validate_tp_serve(cfg, mesh, "make_slot_prefill")
     H = cfg.n_heads
-
-    def build():
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, kc, vc, prompt, slot, key_data, temperature,
-                    top_k, top_p):
-            embed, blocks, head = _merged_stage_trees(params)
-            t0 = prompt.shape[1]
-            ids = prompt.astype(jnp.int32)
-            h = embedding_lookup(embed["tok"], ids) + embed["pos"][:t0]
-            for li, bp in enumerate(blocks):
-                q, k_, v = _dense_qkv(bp, h, H)           # [1, H, T0, dh]
-                kc = jax.lax.dynamic_update_slice(
-                    kc, k_.astype(kc.dtype)[None], (li, slot, 0, 0, 0))
-                vc = jax.lax.dynamic_update_slice(
-                    vc, v.astype(vc.dtype)[None], (li, slot, 0, 0, 0))
-                h = _dense_attn_tail(bp, h, causal_attention_core(q, k_, v))
-            row = _head_logprobs(head, h[:, -1])[0]       # [V]
-            tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
-            return kc, vc, tok, kd
-
-        return prefill
-
-    return _memo_build(("slot_prefill", cfg, max_len), build)
+    key_ = ("slot_prefill", cfg, max_len, mesh)
+    if cfg.n_tensor_parallel > 1:
+        return _memo_build(key_, lambda: _build_slot_prefill_tp(cfg, mesh))
+    return _memo_build(key_, lambda: _build_slot_prefill(H))
 
 
-def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads):
+def _slot_prefill_fwd(blocks, embed, head, kc, vc, prompt, slot, H, tail):
+    """One request's whole-prompt prefill into pool row ``slot`` — the one
+    copy of the math, shared by the single-device and TP builds (``H`` is
+    the LOCAL head count; ``tail`` closes each block)."""
+    t0 = prompt.shape[1]
+    ids = prompt.astype(jnp.int32)
+    h = embedding_lookup(embed["tok"], ids) + embed["pos"][:t0]
+    for li, bp in enumerate(blocks):
+        q, k_, v = _dense_qkv(bp, h, H)               # [1, H, T0, dh]
+        kc = jax.lax.dynamic_update_slice(
+            kc, k_.astype(kc.dtype)[None], (li, slot, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v.astype(vc.dtype)[None], (li, slot, 0, 0, 0))
+        h = tail(bp, h, causal_attention_core(q, k_, v))
+    return kc, vc, _head_logprobs(head, h[:, -1])[0]  # row: [V]
+
+
+def _build_slot_prefill(H):
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def prefill(params, kc, vc, prompt, slot, key_data, temperature,
+                top_k, top_p):
+        embed, blocks, head = _merged_stage_trees(params)
+        kc, vc, row = _slot_prefill_fwd(blocks, embed, head, kc, vc,
+                                        prompt, slot, H, _dense_attn_tail)
+        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
+        return kc, vc, tok, kd
+
+    return prefill
+
+
+def _build_slot_prefill_tp(cfg, mesh):
+    tp = cfg.n_tensor_parallel
+    tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
+    H_loc = cfg.n_heads // tp
+
+    def body(params, kc, vc, prompt, slot, key_data, temperature,
+             top_k, top_p):
+        blocks, embed, head = _tp_local_trees(params)
+        kc, vc, row = _slot_prefill_fwd(blocks, embed, head, kc, vc,
+                                        prompt, slot, H_loc, tail)
+        row = _close_rows(row)
+        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
+        return kc, vc, tok, kd
+
+    return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=6, n_buf_out=2,
+                   n_rest_out=2)
+
+
+def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads,
+                            tail=_dense_attn_tail):
     """One block on one token per SLOT (``h``: [S, 1, d]) against pool
     cache row ``li``; each slot writes its new K/V at its OWN position
     (``pos``: [S]) and attends ``[0, pos]``. Per-slot math is exactly
     :func:`_dense_block_step`'s (same scale expression, same einsums, same
     masked-row softmax), and every slot's output depends only on its own
-    cache row — the bit-exactness anchor continuous batching rests on."""
-    dh = h.shape[-1] // n_heads
+    cache row — the bit-exactness anchor continuous batching rests on.
+    ``n_heads`` is the LOCAL head count and ``tail`` closes the block (the
+    TP build passes ``H/tp`` and :func:`_tp_attn_tail`)."""
     q, knew, vnew = _dense_qkv(bp, h, n_heads)            # [S, H, 1, dh]
+    # scale from the PROJECTED head dim (q's trailing axis), never from
+    # h.shape[-1] // n_heads: under TP the local head count shrinks but the
+    # per-head dim does not, and a local-count-derived scale silently
+    # rescales attention (the causal_attention_core convention)
+    dh = q.shape[-1]
 
     def upd(cache, new, p):
         return jax.lax.dynamic_update_slice(cache, new, (0, p, 0))
@@ -1046,11 +1219,11 @@ def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads):
     scores = jnp.where(live, scores, -jnp.inf)
     a = jnp.einsum("bhqk,bhkd->bhqd",
                    jax.nn.softmax(scores, axis=-1), vci)
-    return _dense_attn_tail(bp, h, a), kc, vc
+    return tail(bp, h, a), kc, vc
 
 
 def make_slot_decode_step(stages, cfg: GPTConfig, max_len: int,
-                          cache_dtype=None):
+                          cache_dtype=None, mesh=None):
     """Serving decode tick: ``step(params, kc, vc, toks [S], pos [S],
     key_data [S, 2], temps [S], top_ks [S], top_ps [S]) -> (kc, vc,
     next_toks [S], next_key_data [S, 2])``.
@@ -1065,28 +1238,59 @@ def make_slot_decode_step(stages, cfg: GPTConfig, max_len: int,
     cache writes are invisible by construction (see ``serve/slots.py``).
     ``kc``/``vc`` are donated (same contract as :func:`make_slot_prefill`):
     one in-place pool update per tick, not a pool-sized copy per token.
+
+    With ``cfg.n_tensor_parallel > 1`` (pass the ``mesh``): the shard_map
+    twin over the head-sharded pool (:func:`make_slot_prefill`'s TP notes
+    apply).
     """
     _validate_slot_build(stages, cfg, max_len, "make_slot_decode_step")
+    mesh = _validate_tp_serve(cfg, mesh, "make_slot_decode_step")
     H = cfg.n_heads
+    key_ = ("slot_decode", cfg, max_len, mesh)
+    if cfg.n_tensor_parallel > 1:
+        return _memo_build(key_, lambda: _build_slot_decode_tp(cfg, mesh))
+    return _memo_build(key_, lambda: _build_slot_decode(H))
 
-    def build():
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def step(params, kc, vc, toks, pos, key_data, temps, top_ks,
-                 top_ps):
-            embed, blocks, head = _merged_stage_trees(params)
-            pe = jnp.take(embed["pos"], pos, axis=0)[:, None]  # [S, 1, d]
-            h = embedding_lookup(embed["tok"], toks[:, None]) + pe
-            for li, bp in enumerate(blocks):
-                h, kc, vc = _dense_block_step_slots(bp, h, li, kc, vc,
-                                                    pos, H)
-            rows = _head_logprobs(head, h[:, 0])               # [S, V]
-            toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
-                                               top_ks, top_ps)
-            return kc, vc, toks2, kd2
 
-        return step
+def _slot_decode_fwd(blocks, embed, head, kc, vc, toks, pos, H, tail):
+    """The batched one-token-per-slot step's forward — shared by the
+    single-device and TP builds and by the speculative draft proposer."""
+    pe = jnp.take(embed["pos"], pos, axis=0)[:, None]      # [S, 1, d]
+    h = embedding_lookup(embed["tok"], toks[:, None]) + pe
+    for li, bp in enumerate(blocks):
+        h, kc, vc = _dense_block_step_slots(bp, h, li, kc, vc, pos, H,
+                                            tail)
+    return kc, vc, _head_logprobs(head, h[:, 0])           # rows: [S, V]
 
-    return _memo_build(("slot_decode", cfg, max_len), build)
+
+def _build_slot_decode(H):
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+        embed, blocks, head = _merged_stage_trees(params)
+        kc, vc, rows = _slot_decode_fwd(blocks, embed, head, kc, vc, toks,
+                                        pos, H, _dense_attn_tail)
+        toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
+                                           top_ks, top_ps)
+        return kc, vc, toks2, kd2
+
+    return step
+
+
+def _build_slot_decode_tp(cfg, mesh):
+    tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
+    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+
+    def body(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+        blocks, embed, head = _tp_local_trees(params)
+        kc, vc, rows = _slot_decode_fwd(blocks, embed, head, kc, vc, toks,
+                                        pos, H_loc, tail)
+        rows = _close_rows(rows)
+        toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
+                                           top_ks, top_ps)
+        return kc, vc, toks2, kd2
+
+    return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=6, n_buf_out=2,
+                   n_rest_out=2)
 
 
 def _validate_paged_build(stages, cfg: GPTConfig, max_len: int,
@@ -1115,7 +1319,7 @@ def _gather_paged_rows(cache_l: jax.Array, table: jax.Array) -> jax.Array:
 
 
 def make_paged_prefill_chunk(stages, cfg: GPTConfig, max_len: int,
-                             block_size: int, cache_dtype=None):
+                             block_size: int, cache_dtype=None, mesh=None):
     """Chunked serving prefill into paged blocks: ``chunk(params, kc, vc,
     tokens [1, c], p0, table [NB], key_data, temperature, top_k, top_p) ->
     (kc, vc, token, key_data)``.
@@ -1147,10 +1351,43 @@ def make_paged_prefill_chunk(stages, cfg: GPTConfig, max_len: int,
     """
     _validate_paged_build(stages, cfg, max_len, block_size,
                           "make_paged_prefill_chunk")
+    mesh = _validate_tp_serve(cfg, mesh, "make_paged_prefill_chunk")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
-    return _memo_build(("paged_chunk", cfg, max_len, block_size),
-                       lambda: _build_paged_prefill_chunk(H, bs, dh))
+    key_ = ("paged_chunk", cfg, max_len, block_size, mesh)
+    if cfg.n_tensor_parallel > 1:
+        return _memo_build(key_, lambda: _build_paged_prefill_chunk_tp(
+            cfg, bs, dh, mesh))
+    return _memo_build(key_, lambda: _build_paged_prefill_chunk(H, bs, dh))
+
+
+def _paged_chunk_fwd(blocks, embed, head, kc, vc, tokens, p0, table, H, bs,
+                     dh, tail):
+    """One prompt chunk's scatter + block-gather attention — the shared
+    forward of the single-device and TP paged prefill builds."""
+    c = tokens.shape[1]
+    ids = tokens.astype(jnp.int32)
+    pos_emb = jax.lax.dynamic_slice_in_dim(embed["pos"], p0, c, 0)
+    h = embedding_lookup(embed["tok"], ids) + pos_emb
+    idx = p0 + jnp.arange(c)
+    phys = table[idx // bs]                       # [c]
+    off = idx % bs
+    span = table.shape[0] * bs
+    live = (jnp.arange(span)[None, :] <= idx[:, None])[None, None]
+    for li, bp in enumerate(blocks):
+        q, k_, v = _dense_qkv(bp, h, H)           # [1, H, c, dh]
+        kc = kc.at[li, phys, :, off, :].set(
+            k_[0].swapaxes(0, 1).astype(kc.dtype))
+        vc = vc.at[li, phys, :, off, :].set(
+            v[0].swapaxes(0, 1).astype(vc.dtype))
+        krow = _gather_paged_rows(kc[li], table)  # [H, span, dh]
+        vrow = _gather_paged_rows(vc[li], table)
+        scores = jnp.einsum("bhqd,hkd->bhqk", q, krow) / math.sqrt(dh)
+        scores = jnp.where(live, scores, -jnp.inf)
+        a = jnp.einsum("bhqk,hkd->bhqd",
+                       jax.nn.softmax(scores, axis=-1), vrow)
+        h = tail(bp, h, a)
+    return kc, vc, _head_logprobs(head, h[:, -1])[0]    # row: [V]
 
 
 def _build_paged_prefill_chunk(H, bs, dh):
@@ -1158,37 +1395,35 @@ def _build_paged_prefill_chunk(H, bs, dh):
     def chunk(params, kc, vc, tokens, p0, table, key_data, temperature,
               top_k, top_p):
         embed, blocks, head = _merged_stage_trees(params)
-        c = tokens.shape[1]
-        ids = tokens.astype(jnp.int32)
-        pos_emb = jax.lax.dynamic_slice_in_dim(embed["pos"], p0, c, 0)
-        h = embedding_lookup(embed["tok"], ids) + pos_emb
-        idx = p0 + jnp.arange(c)
-        phys = table[idx // bs]                       # [c]
-        off = idx % bs
-        span = table.shape[0] * bs
-        live = (jnp.arange(span)[None, :] <= idx[:, None])[None, None]
-        for li, bp in enumerate(blocks):
-            q, k_, v = _dense_qkv(bp, h, H)           # [1, H, c, dh]
-            kc = kc.at[li, phys, :, off, :].set(
-                k_[0].swapaxes(0, 1).astype(kc.dtype))
-            vc = vc.at[li, phys, :, off, :].set(
-                v[0].swapaxes(0, 1).astype(vc.dtype))
-            krow = _gather_paged_rows(kc[li], table)  # [H, span, dh]
-            vrow = _gather_paged_rows(vc[li], table)
-            scores = jnp.einsum("bhqd,hkd->bhqk", q, krow) / math.sqrt(dh)
-            scores = jnp.where(live, scores, -jnp.inf)
-            a = jnp.einsum("bhqk,hkd->bhqd",
-                           jax.nn.softmax(scores, axis=-1), vrow)
-            h = _dense_attn_tail(bp, h, a)
-        row = _head_logprobs(head, h[:, -1])[0]       # [V]
+        kc, vc, row = _paged_chunk_fwd(blocks, embed, head, kc, vc,
+                                       tokens, p0, table, H, bs, dh,
+                                       _dense_attn_tail)
         tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
         return kc, vc, tok, kd
 
     return chunk
 
 
+def _build_paged_prefill_chunk_tp(cfg, bs, dh, mesh):
+    tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
+    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+
+    def body(params, kc, vc, tokens, p0, table, key_data, temperature,
+             top_k, top_p):
+        blocks, embed, head = _tp_local_trees(params)
+        kc, vc, row = _paged_chunk_fwd(blocks, embed, head, kc, vc,
+                                       tokens, p0, table, H_loc, bs, dh,
+                                       tail)
+        row = _close_rows(row)
+        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
+        return kc, vc, tok, kd
+
+    return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=7, n_buf_out=2,
+                   n_rest_out=2)
+
+
 def make_paged_decode_step(stages, cfg: GPTConfig, max_len: int,
-                           block_size: int, cache_dtype=None):
+                           block_size: int, cache_dtype=None, mesh=None):
     """Paged serving decode tick: ``step(params, kc, vc, toks [S], pos [S],
     tables [S, NB], key_data [S, 2], temps [S], top_ks [S], top_ps [S]) ->
     (kc, vc, next_toks [S], next_key_data [S, 2])``.
@@ -1208,13 +1443,49 @@ def make_paged_decode_step(stages, cfg: GPTConfig, max_len: int,
     block (``pos = 0``, all-trash table) — their garbage K/V lands where
     no real table points. ``kc``/``vc`` are donated (one in-place pool
     update per tick).
+
+    With ``cfg.n_tensor_parallel > 1`` (pass the ``mesh``): the shard_map
+    twin over the head-sharded block pool (:func:`make_slot_prefill`'s TP
+    notes apply — block tables and positions stay replicated host inputs).
     """
     _validate_paged_build(stages, cfg, max_len, block_size,
                           "make_paged_decode_step")
+    mesh = _validate_tp_serve(cfg, mesh, "make_paged_decode_step")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
-    return _memo_build(("paged_decode", cfg, max_len, block_size),
-                       lambda: _build_paged_decode_step(H, bs, dh))
+    key_ = ("paged_decode", cfg, max_len, block_size, mesh)
+    if cfg.n_tensor_parallel > 1:
+        return _memo_build(key_, lambda: _build_paged_decode_step_tp(
+            cfg, bs, dh, mesh))
+    return _memo_build(key_, lambda: _build_paged_decode_step(H, bs, dh))
+
+
+def _paged_decode_fwd(blocks, embed, head, kc, vc, toks, pos, tables, H, bs,
+                      dh, tail):
+    """The batched one-token-per-slot block-gather step's forward — shared
+    by the single-device and TP paged decode builds."""
+    pe = jnp.take(embed["pos"], pos, axis=0)[:, None]     # [S, 1, d]
+    h = embedding_lookup(embed["tok"], toks[:, None]) + pe
+    phys = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                               axis=1)[:, 0]              # [S]
+    off = pos % bs
+    span = tables.shape[1] * bs
+    live = (jnp.arange(span)[None, None, None, :]
+            <= pos[:, None, None, None])
+    for li, bp in enumerate(blocks):
+        q, knew, vnew = _dense_qkv(bp, h, H)              # [S, H, 1, dh]
+        kc = kc.at[li, phys, :, off, :].set(
+            knew[:, :, 0, :].astype(kc.dtype))
+        vc = vc.at[li, phys, :, off, :].set(
+            vnew[:, :, 0, :].astype(vc.dtype))
+        krow = _gather_paged_rows(kc[li], tables)         # [S,H,span,dh]
+        vrow = _gather_paged_rows(vc[li], tables)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, krow) / math.sqrt(dh)
+        scores = jnp.where(live, scores, -jnp.inf)
+        a = jnp.einsum("bhqk,bhkd->bhqd",
+                       jax.nn.softmax(scores, axis=-1), vrow)
+        h = tail(bp, h, a)
+    return kc, vc, _head_logprobs(head, h[:, 0])          # rows: [S, V]
 
 
 def _build_paged_decode_step(H, bs, dh):
@@ -1222,33 +1493,32 @@ def _build_paged_decode_step(H, bs, dh):
     def step(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
              top_ps):
         embed, blocks, head = _merged_stage_trees(params)
-        pe = jnp.take(embed["pos"], pos, axis=0)[:, None]     # [S, 1, d]
-        h = embedding_lookup(embed["tok"], toks[:, None]) + pe
-        phys = jnp.take_along_axis(tables, (pos // bs)[:, None],
-                                   axis=1)[:, 0]              # [S]
-        off = pos % bs
-        span = tables.shape[1] * bs
-        live = (jnp.arange(span)[None, None, None, :]
-                <= pos[:, None, None, None])
-        for li, bp in enumerate(blocks):
-            q, knew, vnew = _dense_qkv(bp, h, H)              # [S, H, 1, dh]
-            kc = kc.at[li, phys, :, off, :].set(
-                knew[:, :, 0, :].astype(kc.dtype))
-            vc = vc.at[li, phys, :, off, :].set(
-                vnew[:, :, 0, :].astype(vc.dtype))
-            krow = _gather_paged_rows(kc[li], tables)         # [S,H,span,dh]
-            vrow = _gather_paged_rows(vc[li], tables)
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, krow) / math.sqrt(dh)
-            scores = jnp.where(live, scores, -jnp.inf)
-            a = jnp.einsum("bhqk,bhkd->bhqd",
-                           jax.nn.softmax(scores, axis=-1), vrow)
-            h = _dense_attn_tail(bp, h, a)
-        rows = _head_logprobs(head, h[:, 0])                  # [S, V]
+        kc, vc, rows = _paged_decode_fwd(blocks, embed, head, kc, vc, toks,
+                                         pos, tables, H, bs, dh,
+                                         _dense_attn_tail)
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
         return kc, vc, toks2, kd2
 
     return step
+
+
+def _build_paged_decode_step_tp(cfg, bs, dh, mesh):
+    tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
+    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+
+    def body(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
+             top_ps):
+        blocks, embed, head = _tp_local_trees(params)
+        kc, vc, rows = _paged_decode_fwd(blocks, embed, head, kc, vc, toks,
+                                         pos, tables, H_loc, bs, dh, tail)
+        rows = _close_rows(rows)
+        toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
+                                           top_ks, top_ps)
+        return kc, vc, toks2, kd2
+
+    return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=7, n_buf_out=2,
+                   n_rest_out=2)
 
 
 def make_paged_block_copy():
@@ -1271,6 +1541,510 @@ def make_paged_block_copy():
     return _memo_build(("paged_block_copy",), build)
 
 
+# -- speculative decoding ---------------------------------------------------
+#
+# Draft/verify serving (ISSUE 9): a small draft model proposes tokens with
+# cheap sequential steps, and the target model scores ALL of them in one
+# batched K-token step, emitting the longest prefix it agrees with (plus
+# its own correction at the first disagreement). With spec_k = K, a tick
+# emits 1..K tokens per slot from TWO program dispatches (one propose scan,
+# one verify) instead of one dispatch per token.
+#
+# Index discipline (the engine's contract): a slot at position p with
+# pending input t0 would solo-decode by consuming t0@p -> g0, g0@p+1 -> g1,
+# ... The draft's propose scan runs K steps (consuming t0, d0, .., d_{K-2}
+# at p..p+K-1) producing proposals d0..d_{K-1}; verify consumes the K
+# inputs [t0, d0, .., d_{K-2}] at positions p..p+K-1 in one forward,
+# yielding rows r0..r_{K-1} where r_j is EXACTLY the row solo decode would
+# sample token j+1 from — provided d0..d_{j-1} matched. Greedy acceptance
+# therefore emits g_j = argmax(r_j) for j up to (and including) the first
+# draft mismatch, which keeps greedy speculative decode bit-exact vs the
+# solo make_cached_decoder stream (tests/test_serve.py). The last proposal
+# d_{K-1} is never consumed by verify: the extra draft step exists so the
+# draft cache already covers position p+K-1 when a tick accepts everything
+# (static shapes; no conditional catch-up step next tick).
+#
+# Rejected-tail K/V: verify writes all K positions before it knows how
+# many survive. In-budget positions land in the slot's own rows/blocks and
+# are overwritten by the next tick before they can be attended (the same
+# trailing-write argument the slot pools rest on); positions beyond the
+# slot's remaining token budget (j >= valid_n) are routed to a trash sink —
+# the dense layout's never-live row max_len-1, the paged pool's trash
+# block 0 — so they cannot land past the reservation or in a neighbour.
+#
+# Sampled modes (temperature > 0) use standard residual-rejection
+# sampling: accept draft token d with probability min(1, p(d)/q(d)) on the
+# FILTERED target/draft distributions, else emit a sample from the
+# normalized positive part of (p - q); the first rejection ends the tick's
+# emission for that slot. Marginally each emitted token is distributed
+# exactly as a solo sample, but the key stream spends TWO splits on a
+# rejected position (accept draw + residual draw), so sampled speculative
+# streams are deterministic-per-seed yet not token-identical to solo —
+# only greedy carries the bit-exactness anchor.
+
+
+def _check_spec_k(spec_k: int, caller: str) -> None:
+    if spec_k < 2:
+        raise ValueError(
+            f"{caller}: spec_k must be >= 2 (spec_k=1 is plain one-token "
+            f"decode — use the decode step), got {spec_k}")
+
+
+def _spec_accept_sampled(rows, drafts, draft_rows, valid_n, key_data,
+                         temperature, top_k, top_p):
+    """Per-slot residual-rejection acceptance on the verify rows:
+    ``(rows [K, V], drafts [K-1], draft_rows [K-1, V], valid_n, key_data,
+    temperature, top_k, top_p) -> (toks [K], n_acc, key_data)`` —
+    ``toks[:n_acc]`` are the emitted tokens. ``vmap`` over slots inside
+    the SAMPLED branch of :func:`_spec_accept_rows` (the scheme is
+    documented in the module-section comment); greedy slots' results are
+    discarded by the caller's per-slot select, so the guard temperature
+    below only keeps the math finite."""
+    K = rows.shape[0]
+    safe_t = jnp.where(temperature > 0, temperature, jnp.float32(1.0))
+
+    def samp_step(carry, j):
+        kd, alive = carry
+        k = jax.random.wrap_key_data(kd)
+        nk, ks = jax.random.split(k)               # _sample_dyn's split
+        pt_log = _filter_top_dyn(rows[j] / safe_t, top_k, top_p)
+        pt = jax.nn.softmax(pt_log)
+        jj = jnp.minimum(j, K - 2)
+        d = drafts[jj]
+        qt = jax.nn.softmax(_filter_top_dyn(draft_rows[jj] / safe_t,
+                                            top_k, top_p))
+        accept = (jax.random.uniform(ks)
+                  < jnp.minimum(pt[d] / jnp.maximum(qt[d], 1e-30), 1.0))
+        # rejection: one more split funds the residual draw; an empty
+        # residual (q >= p everywhere it matters, a numerical corner)
+        # falls back to the plain filtered target distribution
+        nk2, kr = jax.random.split(nk)
+        resid = jnp.maximum(pt - qt, 0.0)
+        resid_log = jnp.where(jnp.sum(resid) > 0,
+                              jnp.log(jnp.maximum(resid, 1e-38)), pt_log)
+        r_tok = jax.random.categorical(kr, resid_log).astype(jnp.int32)
+        # the bonus row (j == K-1, no draft): a plain solo-style sample
+        bonus = jax.random.categorical(ks, pt_log).astype(jnp.int32)
+        has_draft = j < K - 1
+        tok = jnp.where(has_draft, jnp.where(accept, d, r_tok), bonus)
+        kd_next = jnp.where(has_draft & ~accept,
+                            jax.random.key_data(nk2),
+                            jax.random.key_data(nk))
+        emit = alive & (j < valid_n)
+        kd = jnp.where(emit, kd_next, kd)
+        return (kd, emit & accept & has_draft), (tok, emit)
+
+    (kd_s, _), (toks_s, emits) = jax.lax.scan(
+        samp_step, (key_data, jnp.bool_(True)), jnp.arange(K))
+    return (toks_s.astype(jnp.int32),
+            jnp.sum(emits.astype(jnp.int32)).astype(jnp.int32), kd_s)
+
+
+def _spec_accept_rows(rows, drafts, draft_rows, valid_n, key_data, temps,
+                      top_ks, top_ps):
+    """Batched speculative acceptance over every slot: ``(rows [S, K, V],
+    drafts [S, K], draft_rows [S, K, V] — the propose outputs VERBATIM,
+    only the first K-1 proposals are consumed — valid_n [S],
+    key_data [S, 2], temps/top_ks/top_ps [S]) -> (toks [S, K],
+    n_acc [S], key_data [S, 2])``.
+
+    Greedy (``temps[s] == 0``): the slot's tokens are the target's own
+    argmaxes; the emitted count is one more than the leading run of
+    draft==argmax matches (the first mismatch position still emits the
+    target's correction), capped at ``valid_n``; no randomness is
+    consumed, so the key stream stays bit-aligned with solo decode.
+    Sampled: the residual-rejection scheme of
+    :func:`_spec_accept_sampled`. The sampled scan sits behind ONE
+    batch-level ``lax.cond`` — an all-greedy tick (every greedy
+    deployment, and the accept-all bench case the >= 2x throughput gate
+    measures) never executes the K-step rejection scan at all, which is
+    what keeps the verify program's marginal per-token cost near the
+    attention math."""
+    g = jnp.argmax(rows, axis=-1).astype(jnp.int32)          # [S, K]
+    lead = jnp.cumprod((drafts[:, :-1] == g[:, :-1]).astype(jnp.int32),
+                       axis=1)
+    m_greedy = jnp.minimum(1 + jnp.sum(lead, axis=1),
+                           valid_n).astype(jnp.int32)
+
+    def sampled(_):
+        return jax.vmap(_spec_accept_sampled)(
+            rows, drafts[:, :-1], draft_rows[:, :-1], valid_n, key_data,
+            temps, top_ks, top_ps)
+
+    def greedy(_):
+        return g, m_greedy, key_data
+
+    toks_s, n_s, kd_s = jax.lax.cond(jnp.any(temps > 0), sampled, greedy,
+                                     None)
+    sm = temps > 0
+    toks = jnp.where(sm[:, None], toks_s, g).astype(jnp.int32)
+    n_acc = jnp.where(sm, n_s, m_greedy).astype(jnp.int32)
+    kd = jnp.where(sm[:, None], kd_s, key_data)
+    return toks, n_acc, kd
+
+
+def make_slot_propose(stages, cfg: GPTConfig, max_len: int, spec_k: int,
+                      cache_dtype=None):
+    """Draft proposer: ``propose(params, kc, vc, toks [S], pos [S],
+    key_data [S, 2], temps [S], top_ks [S], top_ps [S]) -> (kc, vc,
+    drafts [S, K], draft_rows [S, K, V], key_data [S, 2])``.
+
+    ``spec_k`` sequential draft decode steps over the draft's DENSE slot
+    pool, fused into ONE compiled ``lax.scan`` — one dispatch proposes the
+    whole tick's draft tokens (plus their raw log-prob rows, which the
+    sampled verify's rejection test needs). Step j consumes the carried
+    token at position ``pos + j`` (clamped to the never-live trash row
+    ``max_len - 1`` past the budget; see the section comment) and per-slot
+    math is exactly the decode tick's, so draft K/V rows stay valid for
+    every accepted continuation. ``key_data`` is the request's SEPARATE
+    draft key stream (greedy proposals consume none of it). The draft runs
+    single-device/replicated even under a TP target — it is small by
+    design; ``kc``/``vc`` are donated."""
+    _validate_slot_build(stages, cfg, max_len, "make_slot_propose")
+    _check_spec_k(spec_k, "make_slot_propose")
+    if cfg.n_tensor_parallel > 1:
+        raise ValueError(
+            "make_slot_propose runs the draft model single-device "
+            "(replicated under a TP target): build the draft with "
+            "n_tensor_parallel=1")
+    H = cfg.n_heads
+    key_ = ("slot_propose", cfg, max_len, spec_k)
+    return _memo_build(key_, lambda: _build_slot_propose(H, spec_k,
+                                                         max_len))
+
+
+def _build_slot_propose(H, K, ml):
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def propose(params, kc, vc, toks, pos, key_data, temps, top_ks,
+                top_ps):
+        embed, blocks, head = _merged_stage_trees(params)
+
+        def step(carry, j):
+            kc, vc, tok, kd = carry
+            p = jnp.minimum(pos + j, ml - 1)
+            kc, vc, rows = _slot_decode_fwd(blocks, embed, head, kc, vc,
+                                            tok, p, H, _dense_attn_tail)
+            nxt, kd = jax.vmap(_sample_dyn)(rows, kd, temps, top_ks,
+                                            top_ps)
+            return (kc, vc, nxt, kd), (nxt, rows)
+
+        (kc, vc, _, kd2), (drafts, rows) = jax.lax.scan(
+            step, (kc, vc, toks, key_data), jnp.arange(K))
+        return (kc, vc, jnp.moveaxis(drafts, 0, 1),
+                jnp.moveaxis(rows, 0, 1), kd2)
+
+    return propose
+
+
+def _slot_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wpos, H, tail):
+    """K-tokens-per-slot verify forward over the dense slot pool (``xs``:
+    [S, K] input tokens, ``qpos``: [S, K] query positions, ``wpos``:
+    [S, K] K/V write positions — ``qpos`` in budget, the never-live trash
+    row past it). Per-position math is exactly the decode tick's (same
+    projections, same masked-row softmax), which is what extends the PR-5
+    bit-exactness anchor to speculative verify."""
+    S, K = xs.shape
+    pe = jnp.take(embed["pos"], qpos.reshape(-1),
+                  axis=0).reshape(S, K, -1)
+    h = embedding_lookup(embed["tok"], xs) + pe              # [S, K, d]
+    ml = kc.shape[-2]
+    live = (jnp.arange(ml)[None, None, None, :]
+            <= qpos[:, None, :, None])                       # [S,1,K,ml]
+    for li, bp in enumerate(blocks):
+        q, knew, vnew = _dense_qkv(bp, h, H)                 # [S, H, K, dh]
+        dh = q.shape[-1]          # the projected head dim (TP-safe scale)
+
+        def upd(cache, new, wp):
+            return cache.at[:, wp, :].set(new)               # [H, ml, dh]
+
+        kci = jax.vmap(upd)(kc[li], knew.astype(kc.dtype), wpos)
+        vci = jax.vmap(upd)(vc[li], vnew.astype(vc.dtype), wpos)
+        kc = kc.at[li].set(kci)
+        vc = vc.at[li].set(vci)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kci) / math.sqrt(dh)
+        scores = jnp.where(live, scores, -jnp.inf)
+        a = jnp.einsum("bhqk,bhkd->bhqd",
+                       jax.nn.softmax(scores, axis=-1), vci)
+        h = tail(bp, h, a)
+    return kc, vc, _head_logprobs(head, h)                   # [S, K, V]
+
+
+def make_slot_verify_step(stages, cfg: GPTConfig, max_len: int, spec_k: int,
+                          cache_dtype=None, mesh=None):
+    """Target verify tick (dense layout): ``verify(params, kc, vc,
+    toks [S], pos [S], drafts [S, K], draft_rows [S, K, V],
+    valid_n [S], key_data [S, 2], temps [S], top_ks [S], top_ps [S]) ->
+    (kc, vc, toks [S, K], n_acc [S], key_data [S, 2])``.
+
+    ONE batched forward scores all ``spec_k`` positions of every slot
+    (inputs ``[t0, d0, .., d_{K-2}]`` at positions ``pos .. pos+K-1``) and
+    runs :func:`_spec_accept` per slot; ``valid_n`` is the slot's clamp
+    ``min(spec_k, remaining token budget)`` (0 for non-decoding slots),
+    bounding both emission and which positions write real K/V (the rest go
+    to the trash row). ``kc``/``vc`` are donated.
+
+    With ``cfg.n_tensor_parallel > 1`` (pass the ``mesh``): the shard_map
+    twin — head-sharded QKV/O over the head-sharded pool, rows re-closed
+    across the model axis before acceptance, so every shard accepts the
+    same prefix."""
+    _validate_slot_build(stages, cfg, max_len, "make_slot_verify_step")
+    _check_spec_k(spec_k, "make_slot_verify_step")
+    mesh = _validate_tp_serve(cfg, mesh, "make_slot_verify_step")
+    H = cfg.n_heads
+    key_ = ("slot_verify", cfg, max_len, spec_k, mesh)
+    if cfg.n_tensor_parallel > 1:
+        return _memo_build(key_, lambda: _build_slot_verify_tp(
+            cfg, spec_k, max_len, mesh))
+    return _memo_build(key_, lambda: _build_slot_verify(H, spec_k,
+                                                        max_len))
+
+
+def _verify_positions(pos, valid_n, K, ml):
+    """Query/write position plan shared by the dense verify builds:
+    queries at ``pos + j`` (clamped in-table), writes routed to the
+    never-live trash row ``ml - 1`` once past the slot's budget."""
+    j = jnp.arange(K)[None, :]
+    qpos = jnp.minimum(pos[:, None] + j, ml - 1)
+    wpos = jnp.where(j < valid_n[:, None], qpos, ml - 1)
+    return qpos, wpos
+
+
+def _build_slot_verify(H, K, ml):
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+               key_data, temps, top_ks, top_ps):
+        embed, blocks, head = _merged_stage_trees(params)
+        xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
+        qpos, wpos = _verify_positions(pos, valid_n, K, ml)
+        kc, vc, rows = _slot_verify_fwd(blocks, embed, head, kc, vc, xs,
+                                        qpos, wpos, H, _dense_attn_tail)
+        toks2, n_acc, kd2 = _spec_accept_rows(
+            rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
+            top_ps)
+        return kc, vc, toks2, n_acc, kd2
+
+    return verify
+
+
+def _build_slot_verify_tp(cfg, K, ml, mesh):
+    tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
+    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+
+    def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+             key_data, temps, top_ks, top_ps):
+        blocks, embed, head = _tp_local_trees(params)
+        xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
+        qpos, wpos = _verify_positions(pos, valid_n, K, ml)
+        kc, vc, rows = _slot_verify_fwd(blocks, embed, head, kc, vc, xs,
+                                        qpos, wpos, H_loc, tail)
+        rows = _close_rows(rows)
+        toks2, n_acc, kd2 = _spec_accept_rows(
+            rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
+            top_ps)
+        return kc, vc, toks2, n_acc, kd2
+
+    return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=9, n_buf_out=2,
+                   n_rest_out=3)
+
+
+def _paged_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wphys, woff,
+                      tables, H, bs, dh, tail):
+    """K-tokens-per-slot verify forward over the paged block pool: scatter
+    each position's K/V into ``(wphys, woff)`` (the trash block past the
+    budget) and attend the gathered table span, masked per query."""
+    S, K = xs.shape
+    pe = jnp.take(embed["pos"], qpos.reshape(-1),
+                  axis=0).reshape(S, K, -1)
+    h = embedding_lookup(embed["tok"], xs) + pe              # [S, K, d]
+    span = tables.shape[1] * bs
+    live = (jnp.arange(span)[None, None, None, :]
+            <= qpos[:, None, :, None])                       # [S,1,K,span]
+    for li, bp in enumerate(blocks):
+        q, knew, vnew = _dense_qkv(bp, h, H)                 # [S, H, K, dh]
+        kc = kc.at[li, wphys, :, woff, :].set(
+            knew.swapaxes(1, 2).astype(kc.dtype))
+        vc = vc.at[li, wphys, :, woff, :].set(
+            vnew.swapaxes(1, 2).astype(vc.dtype))
+        krow = _gather_paged_rows(kc[li], tables)            # [S,H,span,dh]
+        vrow = _gather_paged_rows(vc[li], tables)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, krow) / math.sqrt(dh)
+        scores = jnp.where(live, scores, -jnp.inf)
+        a = jnp.einsum("bhqk,bhkd->bhqd",
+                       jax.nn.softmax(scores, axis=-1), vrow)
+        h = tail(bp, h, a)
+    return kc, vc, _head_logprobs(head, h)                   # [S, K, V]
+
+
+def make_paged_verify_step(stages, cfg: GPTConfig, max_len: int,
+                           block_size: int, spec_k: int, cache_dtype=None,
+                           mesh=None):
+    """Target verify tick (paged layout): ``verify(params, kc, vc,
+    toks [S], pos [S], drafts [S, K], draft_rows [S, K, V],
+    valid_n [S], tables [S, NB], key_data [S, 2], temps [S], top_ks [S],
+    top_ps [S]) -> (kc, vc, toks [S, K], n_acc [S], key_data [S, 2])``.
+
+    The block-gather twin of :func:`make_slot_verify_step`: per-position
+    physical blocks come from the slot's table (``tables[s, (pos+j)//bs]``
+    at offset ``(pos+j) % bs``), with positions past ``valid_n`` routed to
+    the pool's trash block 0 — a rejected tail (or a non-decoding slot)
+    can neither overrun the slot's reservation nor touch a neighbour's
+    blocks. The engine must have ``ensure_writable``'d positions
+    ``pos .. pos+valid_n-1`` first (same contract as the decode tick).
+    ``kc``/``vc`` are donated. TP: :func:`make_slot_verify_step`'s notes
+    apply."""
+    _validate_paged_build(stages, cfg, max_len, block_size,
+                          "make_paged_verify_step")
+    _check_spec_k(spec_k, "make_paged_verify_step")
+    mesh = _validate_tp_serve(cfg, mesh, "make_paged_verify_step")
+    H, bs = cfg.n_heads, block_size
+    dh = cfg.d_model // H
+    key_ = ("paged_verify", cfg, max_len, block_size, spec_k, mesh)
+    if cfg.n_tensor_parallel > 1:
+        return _memo_build(key_, lambda: _build_paged_verify_step_tp(
+            cfg, spec_k, max_len, bs, dh, mesh))
+    return _memo_build(key_, lambda: _build_paged_verify_step(
+        H, spec_k, max_len, bs, dh))
+
+
+def _paged_verify_routing(pos, valid_n, tables, K, bs, ml):
+    """Per-position write routing for the paged verify: physical block and
+    offset for ``pos + j``, the trash block (0) once past the budget."""
+    j = jnp.arange(K)[None, :]
+    qpos = jnp.minimum(pos[:, None] + j, ml - 1)
+    NB = tables.shape[1]
+    phys = jnp.take_along_axis(tables, jnp.clip(qpos // bs, 0, NB - 1),
+                               axis=1)                       # [S, K]
+    wphys = jnp.where(j < valid_n[:, None], phys, 0)         # 0 == TRASH
+    woff = qpos % bs
+    return qpos, wphys, woff
+
+
+def _build_paged_verify_step(H, K, ml, bs, dh):
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+               tables, key_data, temps, top_ks, top_ps):
+        embed, blocks, head = _merged_stage_trees(params)
+        xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
+        qpos, wphys, woff = _paged_verify_routing(pos, valid_n, tables, K,
+                                                  bs, ml)
+        kc, vc, rows = _paged_verify_fwd(blocks, embed, head, kc, vc, xs,
+                                         qpos, wphys, woff, tables, H, bs,
+                                         dh, _dense_attn_tail)
+        toks2, n_acc, kd2 = _spec_accept_rows(
+            rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
+            top_ps)
+        return kc, vc, toks2, n_acc, kd2
+
+    return verify
+
+
+def _build_paged_verify_step_tp(cfg, K, ml, bs, dh, mesh):
+    tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
+    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+
+    def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+             tables, key_data, temps, top_ks, top_ps):
+        blocks, embed, head = _tp_local_trees(params)
+        xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
+        qpos, wphys, woff = _paged_verify_routing(pos, valid_n, tables, K,
+                                                  bs, ml)
+        kc, vc, rows = _paged_verify_fwd(blocks, embed, head, kc, vc, xs,
+                                         qpos, wphys, woff, tables, H_loc,
+                                         bs, dh, tail)
+        rows = _close_rows(rows)
+        toks2, n_acc, kd2 = _spec_accept_rows(
+            rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
+            top_ps)
+        return kc, vc, toks2, n_acc, kd2
+
+    return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=10, n_buf_out=2,
+                   n_rest_out=3)
+
+
+def _check_spec_tick_build(cfg: GPTConfig, draft_cfg: GPTConfig,
+                           caller: str) -> None:
+    if cfg.n_tensor_parallel > 1:
+        raise ValueError(
+            f"{caller} fuses the single-device tick only — a TP target "
+            f"runs propose and verify as separate dispatches (the verify "
+            f"is a shard_map program; see InferenceEngine)")
+    if draft_cfg.vocab != cfg.vocab:
+        raise ValueError(
+            f"{caller}: draft vocab {draft_cfg.vocab} != target vocab "
+            f"{cfg.vocab}")
+
+
+def make_slot_spec_tick(stages, cfg: GPTConfig, draft_stages,
+                        draft_cfg: GPTConfig, max_len: int, spec_k: int,
+                        cache_dtype=None):
+    """The FUSED speculative tick (dense layout, single-device targets):
+    ``tick(dparams, dkc, dvc, params, kc, vc, toks [S], pos [S],
+    valid_n [S], draft_key_data [S, 2], key_data [S, 2], temps [S],
+    top_ks [S], top_ps [S]) -> (dkc, dvc, kc, vc, toks [S, K],
+    n_acc [S], key_data, draft_key_data)``.
+
+    One compiled program runs the draft propose scan AND the batched
+    target verify — ONE dispatch per speculative tick instead of two, and
+    the ``[S, K, V]`` draft log-prob rows never materialize as a program
+    output (they flow straight into the acceptance test inside the fused
+    program). Exactly :func:`make_slot_propose` composed with
+    :func:`make_slot_verify_step`, so the greedy bit-exactness contract
+    carries over unchanged. All four pool buffers are donated."""
+    _check_spec_tick_build(cfg, draft_cfg, "make_slot_spec_tick")
+    propose = make_slot_propose(draft_stages, draft_cfg, max_len, spec_k,
+                                cache_dtype)
+    verify = make_slot_verify_step(stages, cfg, max_len, spec_k,
+                                   cache_dtype)
+
+    def build():
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
+        def tick(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
+                 dkd, kd, temps, top_ks, top_ps):
+            dkc, dvc, drafts, qrows, dkd2 = propose(
+                dparams, dkc, dvc, toks, pos, dkd, temps, top_ks, top_ps)
+            kc, vc, otoks, nacc, kd2 = verify(
+                params, kc, vc, toks, pos, drafts, qrows, valid_n, kd,
+                temps, top_ks, top_ps)
+            return dkc, dvc, kc, vc, otoks, nacc, kd2, dkd2
+
+        return tick
+
+    return _memo_build(("slot_spec_tick", cfg, draft_cfg, max_len, spec_k),
+                       build)
+
+
+def make_paged_spec_tick(stages, cfg: GPTConfig, draft_stages,
+                         draft_cfg: GPTConfig, max_len: int,
+                         block_size: int, spec_k: int, cache_dtype=None):
+    """Paged twin of :func:`make_slot_spec_tick`: ``tick(dparams, dkc,
+    dvc, params, kc, vc, toks, pos, valid_n, tables [S, NB], dkd, kd,
+    temps, top_ks, top_ps) -> (dkc, dvc, kc, vc, toks [S, K], n_acc [S],
+    key_data, draft_key_data)`` — the draft pool stays the dense slot
+    layout (the engine's draft discipline), the target side is the
+    block-gather :func:`make_paged_verify_step`."""
+    _check_spec_tick_build(cfg, draft_cfg, "make_paged_spec_tick")
+    propose = make_slot_propose(draft_stages, draft_cfg, max_len, spec_k,
+                                cache_dtype)
+    verify = make_paged_verify_step(stages, cfg, max_len, block_size,
+                                    spec_k, cache_dtype)
+
+    def build():
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
+        def tick(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
+                 tables, dkd, kd, temps, top_ks, top_ps):
+            dkc, dvc, drafts, qrows, dkd2 = propose(
+                dparams, dkc, dvc, toks, pos, dkd, temps, top_ks, top_ps)
+            kc, vc, otoks, nacc, kd2 = verify(
+                params, kc, vc, toks, pos, drafts, qrows, valid_n,
+                tables, kd, temps, top_ks, top_ps)
+            return dkc, dvc, kc, vc, otoks, nacc, kd2, dkd2
+
+        return tick
+
+    return _memo_build(("paged_spec_tick", cfg, draft_cfg, max_len,
+                        block_size, spec_k), build)
+
+
 # The memoized decode-path builders, by name — the single list the
 # analyzer's program registry and host-side AST lint key off
 # (analysis/programs.py enumerates these as compiled entry points;
@@ -1283,6 +2057,11 @@ DECODE_BUILDERS = {
     "make_paged_prefill_chunk": make_paged_prefill_chunk,
     "make_paged_decode_step": make_paged_decode_step,
     "make_paged_block_copy": make_paged_block_copy,
+    "make_slot_propose": make_slot_propose,
+    "make_slot_verify_step": make_slot_verify_step,
+    "make_paged_verify_step": make_paged_verify_step,
+    "make_slot_spec_tick": make_slot_spec_tick,
+    "make_paged_spec_tick": make_paged_spec_tick,
 }
 
 

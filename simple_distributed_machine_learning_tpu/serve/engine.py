@@ -83,6 +83,24 @@ class InferenceEngine:
     ``n_slots * ceil(max_len/block_size)`` — shrink it to serve more slots
     than the memory could densely back); ``prefill_chunk`` prompt positions
     per prefill chunk (``None`` = the whole remaining prompt in one chunk).
+
+    Tensor parallelism: build ``cfg`` with ``n_tensor_parallel = tp > 1``
+    (the stages stay the UNSHARDED dense build) and pass a ``mesh`` whose
+    ``model`` axis is exactly ``tp``. The engine slices the dense weights
+    into the Megatron serving layout (``pack_tp_serve_params``) and places
+    the K/V pool sharded over its HEAD axis, so every tick's compiled
+    program runs head-sharded QKV/O + collective-matmul MLP over ``tp``
+    chips and per-chip KV bytes drop by ``tp`` (the pool's
+    ``serve_kv_bytes_resident`` gauge reports PER-SHARD bytes).
+
+    Speculative decoding: pass ``draft_stages``/``draft_cfg`` (a smaller
+    dense single-device build sharing the target's vocab) and
+    ``spec_k >= 2``. Each tick then runs ONE draft propose scan plus ONE
+    batched target verify instead of a one-token decode, emitting 1..
+    ``spec_k`` tokens per slot; greedy requests stay bit-exact vs their
+    solo decode (the models/gpt.py speculative-section contract). The
+    draft keeps its own dense slot-pool K/V buffers and per-request key
+    stream regardless of the target layout.
     """
 
     def __init__(self, stages, cfg, *, params=None, n_slots: int = 4,
@@ -91,13 +109,20 @@ class InferenceEngine:
                  n_blocks: int | None = None, prefill_chunk: int | None = None,
                  metrics: ServeMetrics | None = None,
                  scheduler: FCFSScheduler | None = None,
-                 clock=time.monotonic, lint: bool = False) -> None:
+                 clock=time.monotonic, lint: bool = False,
+                 mesh=None, draft_stages=None, draft_cfg=None,
+                 spec_k: int = 0) -> None:
         from simple_distributed_machine_learning_tpu.models.gpt import (
             make_paged_block_copy,
             make_paged_decode_step,
             make_paged_prefill_chunk,
+            make_paged_spec_tick,
+            make_paged_verify_step,
             make_slot_decode_step,
             make_slot_prefill,
+            make_slot_propose,
+            make_slot_spec_tick,
+            make_slot_verify_step,
         )
         if kv_layout not in ("paged", "dense"):
             raise ValueError(
@@ -111,6 +136,18 @@ class InferenceEngine:
             raise ValueError(
                 "prefill_chunk/n_blocks are paged-pool knobs; the dense "
                 "layout prefills whole prompts into fixed rows")
+        if (draft_stages is None) != (draft_cfg is None):
+            raise ValueError(
+                "speculative decoding needs BOTH draft_stages and "
+                "draft_cfg (the draft build's config)")
+        if draft_stages is not None and spec_k < 2:
+            raise ValueError(
+                f"speculative decoding needs spec_k >= 2 (got {spec_k}); "
+                f"spec_k=1 is plain one-token decode — drop the draft")
+        if draft_stages is None and spec_k:
+            raise ValueError(
+                f"spec_k={spec_k} without draft_stages/draft_cfg — the "
+                f"draft model is what proposes the speculated tokens")
         self.cfg = cfg
         self.stages = stages       # kept for the analyzer's program registry
         self.kv_layout = kv_layout
@@ -118,24 +155,70 @@ class InferenceEngine:
         self.params = (params if params is not None
                        else [s.params for s in stages])
         self.max_len = int(max_len if max_len is not None else cfg.seq_len)
+        self.tp = int(cfg.n_tensor_parallel)
+        self.mesh = mesh if self.tp > 1 else None
+        self.spec_k = int(spec_k)
+        self.speculative = draft_stages is not None
+        self.draft_stages = draft_stages   # for the analyzer's registry
+        self.draft_cfg = draft_cfg
         n_layers = sum(len(p["blocks"]) for p in self.params)
         head_dim = cfg.d_model // cfg.n_heads
         if kv_layout == "paged":
             self.pool = PagedKVPool(n_layers, n_slots, cfg.n_heads,
                                     self.max_len, head_dim, cache_dtype,
-                                    block_size=block_size, n_blocks=n_blocks)
+                                    block_size=block_size, n_blocks=n_blocks,
+                                    tp=self.tp)
             self._chunk_prefill = make_paged_prefill_chunk(
-                stages, cfg, self.max_len, block_size, cache_dtype)
+                stages, cfg, self.max_len, block_size, cache_dtype,
+                mesh=mesh)
             self._decode = make_paged_decode_step(
-                stages, cfg, self.max_len, block_size, cache_dtype)
+                stages, cfg, self.max_len, block_size, cache_dtype,
+                mesh=mesh)
             self._copy_block = make_paged_block_copy()
+            if self.speculative:
+                self._verify = make_paged_verify_step(
+                    stages, cfg, self.max_len, block_size, spec_k,
+                    cache_dtype, mesh=mesh)
         else:
             self.pool = KVCachePool(n_layers, n_slots, cfg.n_heads,
-                                    self.max_len, head_dim, cache_dtype)
+                                    self.max_len, head_dim, cache_dtype,
+                                    tp=self.tp)
             self._prefill = make_slot_prefill(stages, cfg, self.max_len,
-                                              cache_dtype)
+                                              cache_dtype, mesh=mesh)
             self._decode = make_slot_decode_step(stages, cfg, self.max_len,
-                                                 cache_dtype)
+                                                 cache_dtype, mesh=mesh)
+            if self.speculative:
+                self._verify = make_slot_verify_step(
+                    stages, cfg, self.max_len, spec_k, cache_dtype,
+                    mesh=mesh)
+        if self.speculative:
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab} != target vocab "
+                    f"{cfg.vocab} — the draft proposes target token ids")
+            self._draft_prefill = make_slot_prefill(
+                draft_stages, draft_cfg, self.max_len, cache_dtype)
+            self._propose = make_slot_propose(
+                draft_stages, draft_cfg, self.max_len, spec_k, cache_dtype)
+            if self.tp == 1:
+                # single-device targets run the FUSED tick: one dispatch
+                # per speculative tick, draft rows never leave the device
+                self._spec_fused = (
+                    make_paged_spec_tick(stages, cfg, draft_stages,
+                                         draft_cfg, self.max_len,
+                                         block_size, spec_k, cache_dtype)
+                    if kv_layout == "paged" else
+                    make_slot_spec_tick(stages, cfg, draft_stages,
+                                        draft_cfg, self.max_len, spec_k,
+                                        cache_dtype))
+            else:
+                # a TP target verifies in a shard_map program while the
+                # draft stays replicated single-device — two dispatches
+                self._spec_fused = None
+            self._draft_params = [s.params for s in draft_stages]
+            self._init_draft_pool(n_slots, cache_dtype)
+        if self.tp > 1:
+            self._place_tp(mesh)
         if scheduler is None:
             scheduler = FCFSScheduler(self.pool)
         elif not isinstance(scheduler, FCFSScheduler) and callable(scheduler):
@@ -170,6 +253,52 @@ class InferenceEngine:
         # per-request last-emit timestamps for TPOT accounting
         self._last_emit: dict[int, float] = {}
 
+    def _init_draft_pool(self, n_slots: int, cache_dtype) -> None:
+        """The draft model's K/V buffers: ALWAYS the dense slot layout
+        (one ``max_len`` row per slot), whatever the target layout — the
+        draft is small by design, so paging it buys nothing, and the dense
+        trailing-write argument keeps its rejected-tail rows safe."""
+        import jax.numpy as jnp
+
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            _cache_dtype,
+        )
+        dcfg = self.draft_cfg
+        dL = sum(len(p["blocks"]) for p in self._draft_params)
+        ddh = dcfg.d_model // dcfg.n_heads
+        cd = _cache_dtype(cache_dtype)
+        shape = (dL, n_slots, dcfg.n_heads, self.max_len, ddh)
+        self._dkc = jnp.zeros(shape, cd)
+        self._dvc = jnp.zeros(shape, cd)
+
+    def _place_tp(self, mesh) -> None:
+        """Shard the serving state for the TP programs: the K/V pool
+        buffers split over their head axis (per-chip KV drops by ``tp``),
+        the dense stage weights sliced into the Megatron serving layout
+        (``pack_tp_serve_params``) with block shards on the model axis and
+        embed/head replicated. One placement at construction; donation
+        keeps the pool buffers sharded across ticks."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            pack_tp_serve_params,
+        )
+        from simple_distributed_machine_learning_tpu.parallel.mesh import (
+            MODEL_AXIS,
+        )
+        cache_sh = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+        self.pool.kc = jax.device_put(self.pool.kc, cache_sh)
+        self.pool.vc = jax.device_put(self.pool.vc, cache_sh)
+        stacked, rep = pack_tp_serve_params(self.params, self.tp)
+        blk_sh = NamedSharding(mesh, P(MODEL_AXIS))
+        rep_sh = NamedSharding(mesh, P())
+        self.params = (
+            [jax.tree.map(lambda leaf: jax.device_put(leaf, blk_sh), bp)
+             for bp in stacked],
+            jax.tree.map(lambda leaf: jax.device_put(leaf, rep_sh), rep))
+
     # -- public API --------------------------------------------------------
 
     @property
@@ -203,6 +332,13 @@ class InferenceEngine:
         # the request's independent key stream — the SAME key a solo
         # make_cached_decoder call would be handed, so streams align
         r.key_data = np.asarray(jax.random.key_data(jax.random.key(seed)))
+        if self.speculative:
+            # the draft's own stream, derived but disjoint (fold_in), so
+            # sampled proposals never consume the target's splits — greedy
+            # consumes neither, which is what keeps greedy speculative
+            # decode bit-exact vs solo
+            r.draft_key_data = np.asarray(jax.random.key_data(
+                jax.random.fold_in(jax.random.key(seed), 1)))
         r.submit_time = (self._clock() if arrival_time is None
                          else arrival_time)
         self.requests[rid] = r
@@ -233,19 +369,22 @@ class InferenceEngine:
             # occupancy the batched decode actually RUNS at — sampled before
             # same-tick retirement so short requests cannot bias it low
             decode_active = self.pool.n_active
-            emitted += self._decode_tick_dense()
+            emitted += (self._spec_tick(self.pool.active_slots())
+                        if self.speculative else self._decode_tick_dense())
         else:
             self._admit_paged()
             emitted = self._prefill_tick()
             decoding = self._decoding_slots()
             decode_active = len(decoding)
-            emitted += self._decode_tick_paged(decoding)
+            emitted += (self._spec_tick(decoding) if self.speculative
+                        else self._decode_tick_paged(decoding))
         if self.metrics is not None:
             self.metrics.on_tick(
                 self.scheduler.queue_depth, self.pool.n_active,
                 self.pool.n_slots, decode_active=decode_active,
                 block_stats=(self.pool.stats()
-                             if self.kv_layout == "paged" else None))
+                             if self.kv_layout == "paged" else None),
+                tp=self.tp, spec_k=self.spec_k)
         return emitted
 
     def preempt(self, rid: int) -> None:
@@ -313,6 +452,8 @@ class InferenceEngine:
                 np.int32(r.top_k if r.top_k is not None else _NO_TOP_K),
                 np.float32(r.top_p if r.top_p is not None else _NO_TOP_P))
             self.pool.kc, self.pool.vc = kc, vc
+            if self.speculative:
+                self._draft_prefill_slot(r, seq)
             if r.tokens:
                 # resuming after preemption: the prefill only rebuilt K/V;
                 # its sampled token AND advanced key are discarded (the key
@@ -406,6 +547,12 @@ class InferenceEngine:
         # even a 1-token request leaves its prefix reusable (cached blocks
         # survive end_seq as reclaimable)
         self.pool.register_prefix(r.slot, seq)
+        if self.speculative:
+            # the draft prefills the WHOLE sequence in one shot at the
+            # final target chunk: its cache must cover every prompt
+            # position before the first propose scan, and the draft is
+            # cheap by design (no chunking needed)
+            self._draft_prefill_slot(r, seq)
         if r.tokens:
             # resuming after preemption: the final chunk only rebuilt K/V;
             # its sample and advanced key are discarded like a mid-prompt
@@ -469,6 +616,127 @@ class InferenceEngine:
                 src, dst = cp
                 self.pool.kc, self.pool.vc = self._copy_block(
                     self.pool.kc, self.pool.vc, np.int32(dst), np.int32(src))
+
+    # -- speculative tick internals ----------------------------------------
+
+    def _draft_prefill_slot(self, r: Request, seq: np.ndarray) -> None:
+        """Record the draft model's K/V for ``seq`` into the draft pool's
+        slot row. Greedy sampling args + a dummy key: the prefill's sampled
+        token and advanced key are discarded — only the cache write
+        matters, so neither the request's target stream nor its draft
+        stream moves here."""
+        dkc, dvc, _tok, _kd = self._draft_prefill(
+            self._draft_params, self._dkc, self._dvc, seq[None, :],
+            np.int32(r.slot), np.zeros(2, np.uint32), np.float32(0.0),
+            np.int32(_NO_TOP_K), np.float32(_NO_TOP_P))
+        self._dkc, self._dvc = dkc, dvc
+
+    def _spec_tick(self, active: list[int]) -> int:
+        """One speculative decode tick over the decoding slots: the draft
+        propose scan (``spec_k`` fused draft steps) then the batched
+        target verify, emitting 1..``spec_k`` tokens per slot. On a
+        single-device target both halves run as ONE fused compiled
+        program (``make_*_spec_tick``: one dispatch per tick, the draft's
+        ``[S, K, V]`` log-prob rows never leave the device); a TP target
+        runs them as two dispatches (the verify is a shard_map program,
+        the draft stays replicated), proposals flowing between on device
+        with no host sync until the verify returns."""
+        if not active:
+            return 0
+        S, K = self.pool.n_slots, self.spec_k
+        kd, temps, top_ks, top_ps = self._sampling_inputs(active)
+        toks = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        valid = np.zeros(S, np.int32)
+        dkd = np.zeros((S, 2), np.uint32)
+        for s in active:
+            r = self.requests[self.pool.occupant(s)]
+            toks[s] = self.pool.last_token[s]
+            pos[s] = self.pool.positions[s]
+            # the per-slot clamp: never speculate past the remaining token
+            # budget, so every real K/V write stays inside the slot's
+            # reservation (non-decoding slots keep valid 0 -> all-trash)
+            valid[s] = min(K, r.max_new_tokens - len(r.tokens))
+            dkd[s] = r.draft_key_data
+        tables = None
+        if self.kv_layout == "paged":
+            tables = np.full((S, self.pool.blocks_per_seq), PagedKVPool.TRASH,
+                             np.int32)
+            for s in active:
+                self._ensure_writable_range(s, int(pos[s]), int(valid[s]))
+                tables[s] = self.pool.device_table(s)
+        if self._spec_fused is not None:
+            args = (toks, pos, valid) + (() if tables is None
+                                         else (tables,))
+            dkc, dvc, kc, vc, otoks, nacc, kd2, dkd2 = self._spec_fused(
+                self._draft_params, self._dkc, self._dvc, self.params,
+                self.pool.kc, self.pool.vc, *args, dkd, kd, temps,
+                top_ks, top_ps)
+        else:
+            dkc, dvc, drafts, qrows, dkd2 = self._propose(
+                self._draft_params, self._dkc, self._dvc, toks, pos, dkd,
+                temps, top_ks, top_ps)
+            # the propose outputs flow into verify VERBATIM, still on
+            # device; verify itself consumes only the first K-1 proposals
+            # (the K-th exists to keep the draft cache ahead; models/gpt.py
+            # section comment)
+            if tables is not None:
+                kc, vc, otoks, nacc, kd2 = self._verify(
+                    self.params, self.pool.kc, self.pool.vc, toks, pos,
+                    drafts, qrows, valid, tables, kd, temps, top_ks,
+                    top_ps)
+            else:
+                kc, vc, otoks, nacc, kd2 = self._verify(
+                    self.params, self.pool.kc, self.pool.vc, toks, pos,
+                    drafts, qrows, valid, kd, temps, top_ks, top_ps)
+        self._dkc, self._dvc = dkc, dvc
+        self.pool.kc, self.pool.vc = kc, vc
+        return self._emit_spec(active, otoks, nacc, kd2, dkd2, valid)
+
+    def _emit_spec(self, active: list[int], otoks, nacc, kd2, dkd2,
+                   valid) -> int:
+        """Host-side tail of a speculative tick: emit each slot's accepted
+        tokens in order (truncating at EOS — later positions' K/V is
+        already written but gets overwritten before it can be attended),
+        advance positions by the count actually emitted, and feed the
+        proposed/accepted counters."""
+        otoks = np.asarray(otoks)                # host sync: tick endpoint
+        nacc = np.asarray(nacc)
+        kd2 = np.asarray(kd2)
+        dkd2 = np.asarray(dkd2)
+        now = self._clock()
+        emitted = proposed = accepted = 0
+        for s in active:
+            r = self.requests[self.pool.occupant(s)]
+            r.key_data = kd2[s]
+            r.draft_key_data = dkd2[s]
+            m = int(nacc[s])                     # >= 1: valid[s] >= 1
+            n_emit = 0
+            finish = None
+            for tok in otoks[s, :m]:
+                n_emit += 1
+                r.emit(int(tok))
+                finish = r.finished_by(int(tok))
+                if finish is not None:
+                    break
+            dt = now - self._last_emit[r.rid]
+            if self.metrics is not None:
+                # the tick emitted n_emit tokens in one dt window: spread
+                # the interval so the TPOT mean stays the true cadence
+                for _ in range(n_emit):
+                    self.metrics.on_token(dt / n_emit, cls=r.cls)
+            self._last_emit[r.rid] = now
+            emitted += n_emit
+            proposed += max(int(valid[s]) - 1, 0)
+            accepted += max(n_emit - 1, 0)
+            if finish is not None:
+                self._finish(r, finish, now)
+            else:
+                self.pool.positions[s] += n_emit
+                self.pool.last_token[s] = r.tokens[-1]
+        if self.metrics is not None and proposed:
+            self.metrics.on_spec(proposed, accepted)
+        return emitted
 
     # -- shared tick tails -------------------------------------------------
 

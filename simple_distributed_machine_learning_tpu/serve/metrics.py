@@ -28,6 +28,15 @@ the engine hands the pool's stats to :meth:`ServeMetrics.on_tick`):
 - ``serve_prefill_chunk_ms`` (histogram) — per-chunk prefill latency: the
   quantity chunked prefill bounds so decode ticks stay steady.
 
+Sharded + speculative instruments (ISSUE 9):
+
+- ``serve_tp`` / ``serve_spec_k`` (gauges) — the deployment shape: tensor-
+  parallel width and speculative verify width (0 = plain decode);
+- ``serve_spec_proposed_tokens_total`` / ``..accepted..`` / ``..rejected..``
+  (counters) and ``serve_spec_accept_rate`` (histogram, one observation
+  per speculative tick) — how much of the draft's work the target agreed
+  with; accept rate is what converts ``spec_k`` into real tokens/tick.
+
 Traffic-class instruments (populated when requests carry ``cls`` — the
 scenario suite's per-class SLO accounting, ``resilience/scenarios.py``):
 
@@ -101,6 +110,16 @@ class ServeMetrics:
                                for k, v in _POOL_COUNTERS.items()}
         self._pool_counter_seen = dict.fromkeys(_POOL_COUNTERS, 0)
         self._paged_seen = False
+        # sharded + speculative serving instruments: the engine feeds the
+        # shape gauges every tick and the spec counters per verify
+        self.tp_gauge = r.gauge("serve_tp")
+        self.spec_k_gauge = r.gauge("serve_spec_k")
+        self.spec_proposed = r.counter("serve_spec_proposed_tokens_total")
+        self.spec_accepted = r.counter("serve_spec_accepted_tokens_total")
+        self.spec_rejected = r.counter("serve_spec_rejected_tokens_total")
+        self.spec_accept_rate = r.histogram("serve_spec_accept_rate")
+        self._shape_seen = False
+        self._spec_seen = False
         self.preemptions = r.counter("serve_preemptions_total")
         self._classes: set[str] = set()
         if outdir:
@@ -157,9 +176,25 @@ class ServeMetrics:
         layout's monolithic prefill is inside TTFT instead)."""
         self.prefill_chunk_ms.observe(chunk_ms)
 
+    def on_spec(self, proposed: int, accepted: int) -> None:
+        """One speculative tick's draft-token accounting: ``proposed``
+        draft tokens were verified, ``accepted`` survived (the rest were
+        rejected at or after the first target disagreement). The
+        acceptance-rate histogram gets one per-tick observation — with
+        draft == target it pins at 1.0 (tests)."""
+        self._spec_seen = True
+        rejected = proposed - accepted
+        self.spec_proposed.inc(proposed)
+        if accepted:
+            self.spec_accepted.inc(accepted)
+        if rejected:
+            self.spec_rejected.inc(rejected)
+        self.spec_accept_rate.observe(accepted / proposed)
+
     def on_tick(self, queue_depth: int, active: int, total: int,
                 decode_active: int | None = None,
-                block_stats: dict | None = None) -> None:
+                block_stats: dict | None = None,
+                tp: int | None = None, spec_k: int | None = None) -> None:
         """End-of-tick gauges; ``decode_active`` is the occupancy the tick's
         batched decode ran at (sampled BEFORE same-tick retirement — the
         number batching converts into throughput). Ticks that ran no decode
@@ -169,6 +204,10 @@ class ServeMetrics:
         self.queue_depth.set(queue_depth)
         self.slots_active.set(active)
         self.slots_total.set(total)
+        if tp is not None:
+            self._shape_seen = True
+            self.tp_gauge.set(tp)
+            self.spec_k_gauge.set(spec_k or 0)
         occ = active if decode_active is None else decode_active
         if occ and total:
             self.occupancy.observe(occ / total)
@@ -245,6 +284,19 @@ class ServeMetrics:
             "tpot_ms_p95": r3(self.tpot_ms.quantile(0.95)),
             "slot_occupancy_mean": r3(self.occupancy.mean),
         }
+        if self._shape_seen:
+            out["tp"] = int(self.tp_gauge.value)
+            out["spec_k"] = int(self.spec_k_gauge.value)
+        if self._spec_seen:
+            proposed = int(self.spec_proposed.value)
+            accepted = int(self.spec_accepted.value)
+            out.update({
+                "spec_proposed_tokens": proposed,
+                "spec_accepted_tokens": accepted,
+                "spec_rejected_tokens": int(self.spec_rejected.value),
+                "spec_accept_rate": (round(accepted / proposed, 4)
+                                     if proposed else None),
+            })
         if self.preemptions.value:
             out["preemptions"] = int(self.preemptions.value)
         if self._classes:

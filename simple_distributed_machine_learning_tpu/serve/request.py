@@ -56,6 +56,10 @@ class Request:
     prefill_pos: int | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
     key_data: np.ndarray | None = None  # live PRNG key data (uint32 [2])
+    # speculative decoding: the draft model's SEPARATE key stream (set by
+    # the engine when a draft is configured; fold_in(key(seed), 1), so
+    # sampled proposals never consume the target stream's splits)
+    draft_key_data: np.ndarray | None = None
     submit_time: float | None = None
     first_token_time: float | None = None
     done_time: float | None = None

@@ -181,17 +181,31 @@ class _SlotPoolBase:
         return 0
 
 
+def _check_tp(n_heads: int, tp: int) -> int:
+    """Pool-side TP validation: the K/V head axis is what the serving
+    shard_map splits, so ``tp`` must divide ``n_heads``. Byte accounting
+    (``bytes_per_block``, ``serve_kv_bytes_resident``) is PER SHARD —
+    the per-chip resident bytes, the number TP exists to shrink."""
+    if tp < 1 or n_heads % tp:
+        raise ValueError(
+            f"tp={tp} must be >= 1 and divide the K/V head axis "
+            f"(n_heads={n_heads})")
+    return tp
+
+
 class KVCachePool(_SlotPoolBase):
     """Dense fixed-capacity slot pool; see module docstring."""
 
     def __init__(self, n_layers: int, n_slots: int, n_heads: int,
-                 max_len: int, head_dim: int, cache_dtype=None) -> None:
+                 max_len: int, head_dim: int, cache_dtype=None,
+                 tp: int = 1) -> None:
         super().__init__(n_slots, max_len)
         import jax.numpy as jnp
 
         from simple_distributed_machine_learning_tpu.models.gpt import (
             _cache_dtype,
         )
+        self.tp = _check_tp(n_heads, tp)
         shape = (n_layers, n_slots, n_heads, max_len, head_dim)
         cd = _cache_dtype(cache_dtype)
         self.kc = jnp.zeros(shape, cd)
@@ -231,8 +245,10 @@ class PagedKVPool(_SlotPoolBase):
 
     def __init__(self, n_layers: int, n_slots: int, n_heads: int,
                  max_len: int, head_dim: int, cache_dtype=None,
-                 block_size: int = 16, n_blocks: int | None = None) -> None:
+                 block_size: int = 16, n_blocks: int | None = None,
+                 tp: int = 1) -> None:
         super().__init__(n_slots, max_len)
+        self.tp = _check_tp(n_heads, tp)
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.block_size = block_size
@@ -256,8 +272,12 @@ class PagedKVPool(_SlotPoolBase):
         shape = (n_layers, n_blocks + 1, n_heads, block_size, head_dim)
         self.kc = jnp.zeros(shape, cd)
         self.vc = jnp.zeros(shape, cd)
-        self.bytes_per_block = kv_block_bytes(n_layers, n_heads, block_size,
-                                              head_dim, cd)
+        # PER-SHARD bytes (heads split tp ways by the TP serving programs):
+        # the gauge tracks what one chip actually pins, which is the number
+        # TP sharding exists to shrink — and what the analyzer's
+        # predict_kv_bytes_resident must agree with per shard
+        self.bytes_per_block = kv_block_bytes(n_layers, n_heads // self.tp,
+                                              block_size, head_dim, cd)
         # block bookkeeping (host-side, authoritative)
         self.ref = np.zeros(n_blocks + 1, np.int64)
         self._free_blocks: list[int] = list(range(1, n_blocks + 1))[::-1]

@@ -17,6 +17,7 @@ Four contracts pin the whole-program gate:
 Everything except the HBM cross-check is trace-only.
 """
 
+import functools
 import os
 
 import jax
@@ -255,8 +256,19 @@ def test_no_contracts_at_all_still_runs_bounds(stages):
 # ---- 3. retrace policy + memo discipline ---------------------------------
 
 def test_real_builders_are_memoized(stages):
+    # the speculative builders take the draft build (same tiny model here)
+    extra = {
+        "make_slot_propose": lambda m: m(stages, CFG, 16, 4),
+        "make_slot_verify_step": lambda m: m(stages, CFG, 16, 4),
+        "make_paged_verify_step": lambda m: m(stages, CFG, 16, 4, 4),
+        "make_slot_spec_tick": lambda m: m(stages, CFG, stages, CFG, 16, 4),
+        "make_paged_spec_tick": lambda m: m(stages, CFG, stages, CFG, 16,
+                                            4, 4),
+    }
     for name, make in DECODE_BUILDERS.items():
-        if name == "make_cached_decoder":
+        if name in extra:
+            build = functools.partial(extra[name], make)
+        elif name == "make_cached_decoder":
             def build():
                 return make(stages, CFG, 4, 4)
         elif name == "make_paged_block_copy":
@@ -427,6 +439,104 @@ def test_predicted_resident_bytes_match_gauge(stages, block_size, n_reqs,
     span = -(-ml // block_size) * block_size
     assert gather.bytes_per_tick == (
         n_reqs * engine.pool.bytes_per_block * span // block_size)
+
+
+# ---- sharded + speculative registry (ISSUE 9) ----------------------------
+
+def _draft():
+    import dataclasses
+    dcfg = dataclasses.replace(CFG, n_layers=1)
+    return make_gpt_stages(jax.random.key(1), dcfg, 1)[0], dcfg
+
+
+def test_registry_clean_speculative_both_layouts(stages):
+    """The draft propose scan, the batched verify and the FUSED composite
+    tick join the registry and lint clean — the proof, not silence, rule
+    of contract 1 extends to every speculative program."""
+    draft_stages, dcfg = _draft()
+    for s in (ServeSpec(CFG, n_slots=3, max_len=16, kv_layout="paged",
+                        block_size=4, prefill_chunk=3, prompt_lens=BUCKETS,
+                        spec_k=4, draft_cfg=dcfg),
+              ServeSpec(CFG, n_slots=3, max_len=16, kv_layout="dense",
+                        prompt_lens=BUCKETS, spec_k=4, draft_cfg=dcfg)):
+        report = lint_serve(stages, s, draft_stages=draft_stages)
+        assert report.ok(fail_on="warning"), report.format()
+        rules = {f.rule for f in report.findings}
+        assert "trace.failed" not in rules
+        assert "scatter-bounds.unproven-promise" not in rules
+        programs, _ = build_registry(stages, s, draft_stages=draft_stages)
+        names = {p.name for p in programs}
+        want = ({"paged_propose", "paged_verify", "paged_spec_tick"}
+                if s.kv_layout == "paged"
+                else {"slot_propose", "slot_verify", "dense_spec_tick"})
+        assert want <= names, names
+
+
+def test_lint_serve_requires_the_draft_build():
+    _, dcfg = _draft()
+    s = ServeSpec(CFG, n_slots=2, max_len=16, kv_layout="dense",
+                  prompt_lens=BUCKETS, spec_k=4, draft_cfg=dcfg)
+    with pytest.raises(ValueError, match="draft_stages"):
+        lint_serve(None, s)
+
+
+def test_registry_clean_tp2(stages):
+    """TP-sharded serving programs on a live 2-device model mesh: the
+    mesh-axis and scatter-bounds rules walk the sharded block gathers of
+    the exact shard_map twins the TP engine runs — clean on both layouts,
+    and TP without the mesh is refused."""
+    import dataclasses
+
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    cfg2 = dataclasses.replace(CFG, n_tensor_parallel=2)
+    mesh = make_mesh(n_stages=1, n_data=1, n_model=2)
+    for s in (ServeSpec(cfg2, n_slots=3, max_len=16, kv_layout="paged",
+                        block_size=4, prefill_chunk=3,
+                        prompt_lens=BUCKETS),
+              ServeSpec(cfg2, n_slots=3, max_len=16, kv_layout="dense",
+                        prompt_lens=BUCKETS)):
+        report = lint_serve(stages, s, mesh=mesh)
+        assert report.ok(fail_on="warning"), report.format()
+        assert "trace.failed" not in {f.rule for f in report.findings}
+    with pytest.raises(ValueError, match="mesh"):
+        lint_serve(stages, ServeSpec(cfg2, n_slots=3, max_len=16,
+                                     kv_layout="dense",
+                                     prompt_lens=BUCKETS))
+
+
+def test_hbm_per_shard_bytes(stages):
+    """Under TP the HBM model reports PER-SHARD bytes: every K/V stream
+    row halves at tp=2, the resident-bytes prediction halves, and the
+    prediction still equals a live tp-declared pool's gauge exactly."""
+    import dataclasses
+
+    from simple_distributed_machine_learning_tpu.serve.slots import (
+        PagedKVPool,
+    )
+    cfg2 = dataclasses.replace(CFG, n_tensor_parallel=2)
+    s1 = ServeSpec(CFG, n_slots=3, max_len=16, kv_layout="paged",
+                   block_size=4, prefill_chunk=3)
+    s2 = dataclasses.replace(s1, cfg=cfg2)
+    c1 = {h.op: h.bytes_per_tick for h in hbm_tick_costs(s1)}
+    c2 = {h.op: h.bytes_per_tick for h in hbm_tick_costs(s2)}
+    assert set(c1) == set(c2)
+    for op in c1:
+        assert c2[op] * 2 == c1[op], op
+    rows = [5, 9]
+    assert (predict_kv_bytes_resident(s2, rows) * 2
+            == predict_kv_bytes_resident(s1, rows))
+    # the pool's own per-shard accounting is the same rule, so the gauge
+    # parity of contract 4 carries over shard-for-shard (a LIVE tp=2
+    # engine's gauge is cross-checked in tests/test_serve.py)
+    kw = dict(n_layers=CFG.n_layers, n_slots=3, n_heads=CFG.n_heads,
+              max_len=16, head_dim=CFG.d_model // CFG.n_heads,
+              block_size=4)
+    assert (PagedKVPool(**kw, tp=2).bytes_per_block * 2
+            == PagedKVPool(**kw).bytes_per_block)
+    with pytest.raises(ValueError, match="divide"):
+        PagedKVPool(**kw, tp=3)
 
 
 # ---- engine + CLI wiring -------------------------------------------------

@@ -424,6 +424,24 @@ def test_bench_supervised_smoke_non_wedge_rc_stays_fatal():
     assert ei.value.code == 3
 
 
+def test_bench_serve_round_records_device_unhealthy(tmp_path, monkeypatch,
+                                                    capsys):
+    """The r04/r05 stale-baseline fix: a --serve round on a persistently
+    wedged device writes the structured device_unhealthy record INTO
+    benchmarks/serving.json (and exits clean), so the artifact is never
+    silently stale and the next healthy round re-establishes the baseline
+    by overwriting it with real rows."""
+    bench = _bench()
+    (tmp_path / "benchmarks").mkdir()
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_supervised_smoke", lambda: False)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--serve"])
+    bench.main()
+    art = json.loads((tmp_path / "benchmarks" / "serving.json").read_text())
+    assert art["device_unhealthy"] is True
+    assert art["rc"] == 17 and art["rows"] == []
+
+
 def test_bench_probe_subprocess_wedge_signature():
     """The real probe subprocess: an injected wedged-device fault at the
     bench.probe site produces exactly the rc-17 signature (without jax

@@ -16,6 +16,7 @@ bench claims: continuous beats sequential, paged sustains more concurrency
 at fixed KV bytes, chunked prefill cuts the long-prompt stall tick.
 """
 
+import dataclasses
 import json
 import os
 
@@ -37,6 +38,7 @@ from simple_distributed_machine_learning_tpu.serve import (
     simulate,
 )
 from simple_distributed_machine_learning_tpu.serve.request import (
+    ACTIVE,
     DONE,
     Request,
     validate_request,
@@ -652,6 +654,12 @@ def test_serve_cli_flag_validation():
         main(base + ["--serve-shared-prefix", "-2"])
     with pytest.raises(SystemExit, match="leaves no room"):
         main(base + ["--serve-shared-prefix", "60"])
+    with pytest.raises(SystemExit, match="serve-tp"):
+        main(base + ["--serve-tp", "0"])
+    with pytest.raises(SystemExit, match="divide"):
+        main(base + ["--serve-tp", "3"])
+    with pytest.raises(SystemExit, match="serve-spec-k"):
+        main(base + ["--serve-spec-k", "1"])
 
 
 def test_serve_sim_rejects_sharded_builds():
@@ -755,3 +763,287 @@ def test_bench_chunked_prefill_cuts_stall_tick_latency():
                 and chunked["tick_ms_p95"] < mono["tick_ms_p95"]):
             return
     raise AssertionError(f"chunked prefill never beat monolithic: {last}")
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (draft/verify) + tensor-parallel serving (ISSUE 9)
+#
+# The PR-5 anchor extends: a GREEDY request served speculatively emits
+# bit-exactly its solo make_cached_decoder tokens — the verify rows are the
+# same math the plain decode tick computes, and greedy acceptance emits the
+# target's own argmaxes. TP=2 must reproduce TP=1 token-for-token (the
+# all-reduce + pmean row-closing makes every shard sample identical rows).
+
+
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1)
+_DRAFT_STAGES = None
+
+
+def _draft_model():
+    global _DRAFT_STAGES
+    if _DRAFT_STAGES is None:
+        _DRAFT_STAGES = make_gpt_stages(jax.random.key(9), DRAFT_CFG, 1)[0]
+    return _DRAFT_STAGES
+
+
+def _spec_engine(layout="paged", slots=3, spec_k=4, draft_stages=None,
+                 draft_cfg=None, **kw):
+    stages, _ = _model()
+    if layout == "paged":
+        kw.setdefault("block_size", 8)
+    return InferenceEngine(
+        stages, CFG, n_slots=slots, kv_layout=layout,
+        draft_stages=(_draft_model() if draft_stages is None
+                      else draft_stages),
+        draft_cfg=draft_cfg or DRAFT_CFG, spec_k=spec_k, **kw)
+
+
+def test_spec_greedy_bitexact_mixed_and_midflight():
+    """Greedy speculative decode, paged layout: mixed prompt lengths with
+    queueing plus a mid-flight admission — every request's tokens equal
+    its solo decode exactly (the acceptance rule's bit-exactness pin)."""
+    stages, params = _model()
+    eng = _spec_engine(slots=2)
+    specs = [
+        dict(prompt=_prompt(3, 60), max_new_tokens=9, seed=70),
+        dict(prompt=_prompt(9, 61), max_new_tokens=5, seed=71),
+        dict(prompt=_prompt(5, 62), max_new_tokens=8, seed=72),
+    ]
+    handles = [eng.submit(**s) for s in specs]
+    for _ in range(3):                  # first requests mid-stream
+        eng.step()
+    late = dict(prompt=_prompt(6, 63), max_new_tokens=7, seed=73)
+    handles.append(eng.submit(**late))
+    specs.append(late)
+    eng.drain()
+    for h, s in zip(handles, specs):
+        np.testing.assert_array_equal(
+            h.tokens, _solo(stages, params, s["prompt"],
+                            s["max_new_tokens"], s["seed"]))
+
+
+def test_spec_eos_early_exit_parity():
+    """EOS mid-verify: the emitted tokens stop at (and include) the first
+    EOS even when the tick accepted a longer prefix — the retired slot's
+    already-written tail K/V is unreachable (trailing-write/trash-page
+    discipline), so co-residents stay bit-exact."""
+    stages, params = _model()
+    solo = _solo(stages, params, _prompt(5, 64), 8, 74)
+    eos = int(solo[2])
+    cut = int(np.where(solo == eos)[0][0]) + 1
+    eng = _spec_engine(slots=2)
+    r = eng.submit(_prompt(5, 64), max_new_tokens=8, seed=74, eos_id=eos)
+    r2 = eng.submit(_prompt(4, 65), max_new_tokens=6, seed=75)
+    eng.drain()
+    assert r.finish_reason == "eos"
+    assert len(r.tokens) == cut < 8
+    np.testing.assert_array_equal(r.tokens, solo[:cut])
+    np.testing.assert_array_equal(
+        r2.tokens, _solo(stages, params, r2.prompt, 6, 75))
+
+
+@pytest.mark.slow
+def test_spec_dense_layout_parity():
+    """The dense slot pool serves the same speculative streams."""
+    stages, params = _model()
+    eng = _spec_engine(layout="dense", slots=2)
+    handles = [eng.submit(_prompt(n, 80 + n), max_new_tokens=7, seed=80 + n)
+               for n in (3, 7, 5)]
+    eng.drain()
+    for h in handles:
+        np.testing.assert_array_equal(
+            h.tokens, _solo(stages, params, h.prompt, 7, h.seed))
+
+
+@pytest.mark.slow
+def test_spec_preemption_parity():
+    """PR-7 preemption composes with speculative decoding: a victim
+    requeues mid-stream, re-prefills (target AND draft caches rebuilt) and
+    continues bit-exact vs its solo decode."""
+    stages, params = _model()
+    eng = _spec_engine(slots=2, prefill_chunk=8)
+    r1 = eng.submit(_prompt(4, 90), max_new_tokens=10, seed=90)
+    r2 = eng.submit(_prompt(6, 91), max_new_tokens=8, seed=91)
+    for _ in range(3):
+        eng.step()
+    assert 0 < len(r1.tokens) < 10
+    eng.preempt(r1.rid)
+    assert r1.n_preempted == 1
+    eng.drain()
+    np.testing.assert_array_equal(
+        r1.tokens, _solo(stages, params, r1.prompt, 10, 90))
+    np.testing.assert_array_equal(
+        r2.tokens, _solo(stages, params, r2.prompt, 8, 91))
+
+
+def test_spec_accept_all_rate_and_tokens_per_tick():
+    """draft == target: every greedy proposal verifies — accept_rate pins
+    at 1.0, a full-budget tick emits spec_k tokens, and the spec counters
+    + shape gauges land in the metrics record."""
+    stages, params = _model()
+    metrics = ServeMetrics()
+    eng = _spec_engine(slots=2, spec_k=4, draft_stages=stages,
+                       draft_cfg=CFG, metrics=metrics)
+    r = eng.submit(_prompt(5, 95), max_new_tokens=8, seed=95)
+    eng.step()                               # admit + prefill + first tick
+    ticks = 1
+    while r.state != DONE:
+        eng.step()
+        ticks += 1
+    np.testing.assert_array_equal(
+        r.tokens, _solo(stages, params, r.prompt, 8, 95))
+    # 8 tokens at 4/tick: the first tick prefills AND verifies (paged
+    # whole-prompt chunk), so the whole request takes exactly 2 ticks
+    assert ticks == 2, ticks
+    s = metrics.summary()
+    assert s["spec_accept_rate"] == 1.0
+    assert s["spec_proposed_tokens"] == s["spec_accepted_tokens"] > 0
+    assert s["spec_rejected_tokens"] == 0
+    assert s["tp"] == 1 and s["spec_k"] == 4
+
+
+@pytest.mark.slow
+def test_spec_sampled_deterministic_per_seed():
+    """Sampled speculative streams are deterministic per seed (the
+    residual-rejection draws come from the request's own key streams) and
+    a greedy co-resident still matches its solo decode exactly."""
+    stages, params = _model()
+
+    def run():
+        eng = _spec_engine(slots=2, spec_k=3)
+        h1 = eng.submit(_prompt(5, 96), max_new_tokens=7, seed=96,
+                        temperature=0.9, top_k=6)
+        h2 = eng.submit(_prompt(4, 97), max_new_tokens=6, seed=97)
+        eng.drain()
+        return list(h1.tokens), list(h2.tokens)
+
+    a1, a2 = run()
+    b1, b2 = run()
+    assert a1 == b1
+    np.testing.assert_array_equal(
+        a2, _solo(stages, params, _prompt(4, 97), 6, 97))
+    assert a2 == b2
+
+
+def _tp_engine(layout, tp, spec=False, **kw):
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    stages, _ = _model()
+    cfg = dataclasses.replace(CFG, n_tensor_parallel=tp)
+    mesh = make_mesh(n_stages=1, n_data=1, n_model=tp) if tp > 1 else None
+    if layout == "paged":
+        kw.setdefault("block_size", 8)
+    if spec:
+        kw.update(draft_stages=_draft_model(), draft_cfg=DRAFT_CFG,
+                  spec_k=4)
+    return InferenceEngine(stages, cfg, n_slots=2, kv_layout=layout,
+                           mesh=mesh, **kw)
+
+
+def test_tp2_matches_tp1_dense():
+    """TP=2 serving on a 2-CPU-device model mesh reproduces the TP=1
+    stream token-for-token (dense layout): head-sharded QKV/O + the
+    collective-matmul MLP + the pmean row-closing are the same math."""
+    stages, params = _model()
+    eng = _tp_engine("dense", 2)
+    assert eng.pool.tp == 2
+    handles = [eng.submit(_prompt(n, 100 + n), max_new_tokens=6,
+                          seed=100 + n) for n in (4, 7)]
+    eng.drain()
+    for h in handles:
+        np.testing.assert_array_equal(
+            h.tokens, _solo(stages, params, h.prompt, 6, h.seed))
+
+
+@pytest.mark.slow
+def test_tp2_matches_tp1_paged_and_gauge_per_shard():
+    """Paged TP=2 parity, plus the byte accounting: the pool's
+    serve_kv_bytes_resident gauge reports PER-SHARD bytes and equals the
+    analyzer's per-shard prediction exactly."""
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        ServeSpec,
+        predict_kv_bytes_resident,
+    )
+    stages, params = _model()
+    eng = _tp_engine("paged", 2)
+    handles = [eng.submit(_prompt(n, 110 + n), max_new_tokens=6,
+                          seed=110 + n) for n in (4, 7)]
+    for _ in range(4):
+        eng.step()
+    rows = []
+    for h in handles:
+        if h.state != ACTIVE:
+            continue
+        rows.append(h.prefill_pos if h.prefill_pos is not None
+                    else int(h.prompt.shape[0]) + len(h.tokens) - 1)
+    sspec = ServeSpec(dataclasses.replace(CFG, n_tensor_parallel=2),
+                      n_slots=2, kv_layout="paged", block_size=8)
+    assert (predict_kv_bytes_resident(sspec, [r for r in rows if r > 0])
+            == eng.pool.stats()["kv_bytes_resident"] > 0)
+    eng.drain()
+    for h in handles:
+        np.testing.assert_array_equal(
+            h.tokens, _solo(stages, params, h.prompt, 6, h.seed))
+
+
+@pytest.mark.slow
+def test_tp2_with_speculation_matches_solo():
+    """Both tentpole axes at once: a TP=2 target verifying a replicated
+    draft's proposals still reproduces the solo stream exactly."""
+    stages, params = _model()
+    eng = _tp_engine("paged", 2, spec=True)
+    handles = [eng.submit(_prompt(n, 120 + n), max_new_tokens=6,
+                          seed=120 + n) for n in (3, 6)]
+    eng.drain()
+    for h in handles:
+        np.testing.assert_array_equal(
+            h.tokens, _solo(stages, params, h.prompt, 6, h.seed))
+
+
+def test_spec_and_tp_engine_validation():
+    """Constructor contracts: the half-configured speculative/TP states
+    all refuse loudly (no compiles happen on these paths)."""
+    stages, _ = _model()
+    with pytest.raises(ValueError, match="spec_k >= 2"):
+        InferenceEngine(stages, CFG, n_slots=2,
+                        draft_stages=_draft_model(), draft_cfg=DRAFT_CFG,
+                        spec_k=1)
+    with pytest.raises(ValueError, match="BOTH draft_stages"):
+        InferenceEngine(stages, CFG, n_slots=2, draft_stages=stages,
+                        spec_k=4)
+    with pytest.raises(ValueError, match="without draft_stages"):
+        InferenceEngine(stages, CFG, n_slots=2, spec_k=4)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = dataclasses.replace(DRAFT_CFG, vocab=CFG.vocab + 1)
+        InferenceEngine(stages, CFG, n_slots=2,
+                        draft_stages=_draft_model(), draft_cfg=bad,
+                        spec_k=4)
+    with pytest.raises(ValueError, match="mesh"):
+        InferenceEngine(stages,
+                        dataclasses.replace(CFG, n_tensor_parallel=2),
+                        n_slots=2)
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        make_slot_propose,
+    )
+    with pytest.raises(ValueError, match="single-device"):
+        make_slot_propose(stages,
+                          dataclasses.replace(CFG, n_tensor_parallel=2),
+                          16, 4)
+
+
+def test_bench_spec_beats_plain_2x():
+    """The acceptance gate: with draft == target (accept-all) the
+    speculative engine serves >= 2x the plain engine's aggregate
+    tokens-per-tick on the identical workload — deterministic tick
+    counts, not wall clock, so a loaded CI box cannot flake it."""
+    from bench import _measure_spec_vs_plain
+    stages, _ = _model()
+    [row] = _measure_spec_vs_plain(stages, CFG, slots=3, n_requests=8,
+                                   max_new=16, prompt_lens=(4, 8),
+                                   block_size=8)
+    assert row["accept_rate"] == 1.0
+    assert row["speedup_vs_plain"] >= 2.0, row
+    assert row["ticks_spec"] < row["ticks_plain"]
+    for k in ("wall_tokens_per_sec_spec", "wall_tokens_per_sec_plain"):
+        assert row[k] > 0
