@@ -712,6 +712,14 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
             lp_lens = (min(prompt_lens), geo["long_len"])
             for _label, kw in geo["longprompt"]:
                 sspecs.append(ServeSpec(cfg, prompt_lens=lp_lens, **kw))
+            # the availability row's supervised engine (chunked prefill =
+            # block_size bounds its recovery-retrace shapes) — a distinct
+            # compiled geometry, so it preflights too
+            sspecs.append(ServeSpec(cfg, n_slots=min(slots, 4),
+                                    kv_layout="paged",
+                                    block_size=block_size,
+                                    prefill_chunk=block_size,
+                                    prompt_lens=prompt_lens))
         seen = []
         for sspec in sspecs:
             if sspec in seen:
@@ -767,6 +775,13 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                                        max_new=max_new,
                                        prompt_lens=prompt_lens,
                                        block_size=block_size)
+        # the availability row: completed-within-deadline fraction while a
+        # mid-flight engine crash restarts through the serve supervisor
+        rows += _measure_availability(stages, cfg, slots=min(slots, 4),
+                                      n_requests=n_requests,
+                                      max_new=max_new,
+                                      prompt_lens=prompt_lens,
+                                      block_size=block_size)
     if default_shape:
         with open(os.path.join(REPO, "benchmarks", "serving.json"),
                   "w") as f:
@@ -999,6 +1014,84 @@ def _measure_spec_vs_plain(stages, cfg, slots: int, n_requests: int,
         # informational wall-clock columns
         "wall_tokens_per_sec_spec": sr["wall_tokens_per_sec"],
         "wall_tokens_per_sec_plain": pr["wall_tokens_per_sec"],
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }]
+
+
+def _measure_availability(stages, cfg, slots: int, n_requests: int,
+                          max_new: int, prompt_lens: tuple,
+                          block_size: int, deadline_s: float = 120.0,
+                          crash_tick: int = 5, max_restarts: int = 3
+                          ) -> list:
+    """Serving availability under an injected engine crash: the fraction
+    of requests that complete WITHIN their deadline while the serve
+    supervisor (``serve/supervisor.py``) rebuilds the crashed engine and
+    recovers every in-flight request from the journal.
+
+    One ``engine-crash@serve.tick`` fires mid-flight; the row reports
+    ``availability`` = completed-within-deadline / submitted (requests the
+    supervisor shed on an expired deadline count AGAINST availability —
+    that is the metric's point), the restart count, and how many requests
+    were recovered from the journal.  With the default generous deadline
+    the smoke shape pins availability == 1.0 and restarts >= 1
+    (tests/test_serve_supervisor.py): a crash costs a restart, not
+    completions.  Tightening ``deadline_s`` turns the same harness into a
+    recovery-latency budget measurement."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.resilience import faults
+    from simple_distributed_machine_learning_tpu.serve import (
+        ServeMetrics,
+        ServeSupervisor,
+        engine_factory,
+    )
+
+    metrics = ServeMetrics()
+    plan = faults.install(faults.FaultPlan.parse(
+        f"engine-crash@serve.tick={crash_tick}"))
+    tmpdir = tempfile.TemporaryDirectory(prefix="sdml-bench-journal-")
+    try:
+        sup = ServeSupervisor(
+            # chunked prefill bounds the recovery re-prefill to chunk-sized
+            # compiled shapes (the engine.preempt compile-cost note)
+            engine_factory(stages, cfg, n_slots=slots, kv_layout="paged",
+                           block_size=block_size, prefill_chunk=block_size,
+                           metrics=metrics),
+            os.path.join(tmpdir.name, "journal.jsonl"), metrics=metrics,
+            max_restarts=max_restarts, default_deadline_s=deadline_s)
+        rng = np.random.default_rng(0)
+        t0w = _time.perf_counter()
+        for i in range(n_requests):
+            sup.submit(
+                rng.integers(0, cfg.vocab,
+                             prompt_lens[i % len(prompt_lens)]).astype(
+                                 np.int32),
+                max_new_tokens=max_new)
+        sup.drain()
+        sup.close()
+        wall = _time.perf_counter() - t0w
+    finally:
+        faults.uninstall()
+        tmpdir.cleanup()
+    s = metrics.summary()
+    completed = sum(1 for r in sup.requests.values() if r.state == "done")
+    return [{
+        "config": "gpt_serve_availability_crash", "n_slots": slots,
+        "n_requests": n_requests, "max_new_tokens": max_new,
+        "deadline_s": deadline_s, "crash_tick": crash_tick,
+        # the headline: completed-within-deadline fraction under the crash
+        "availability": round(completed / n_requests, 4),
+        "completed": completed,
+        "shed_deadline": s.get("shed_by_reason", {}).get("deadline", 0),
+        "restarts": s.get("restarts", 0),
+        "recovered_requests": s.get("recovered_requests", 0),
+        "faults_fired": plan.stats()["total_fired"],
+        "wall_s": round(wall, 3),
         "device_kind": jax.devices()[0].device_kind,
         "backend": jax.default_backend(),
     }]

@@ -52,7 +52,9 @@ def main(argv=None) -> int:
                    help="lint the serving-program registry (cached decoder "
                         "+ slot/paged prefill, decode, CoW copy and the "
                         "composite tick) over the paged layout at two "
-                        "block/chunk shapes plus the dense layout")
+                        "block/chunk shapes, the dense layout, the "
+                        "speculative pair and the serve supervisor's "
+                        "degraded-fallback layout")
     p.add_argument("--hostlint", action="store_true",
                    help="host-side AST lint: decode builders memoized "
                         "through _DECODE_BUILD_CACHE, no bypass call "

@@ -504,6 +504,20 @@ def cfg_dense(cfg):
     return dataclasses.replace(cfg, n_tensor_parallel=1)
 
 
+def degraded_spec(sspec: ServeSpec) -> ServeSpec:
+    """The serve supervisor's degraded-fallback deployment for ``sspec`` —
+    the SAME transform ``serve/supervisor.py::engine_factory`` applies when
+    rebuilding past ``degrade_after`` restarts: speculation off, tensor
+    parallelism off, dense slot rows.  Kept here as one function so the
+    registry sweep (:func:`default_registry_reports`) lints the exact
+    layout a chaos-stressed supervisor will rebuild into — a fallback that
+    only exists on the worst day must be proven clean on every PR."""
+    return ServeSpec(cfg_dense(sspec.cfg), n_slots=sspec.n_slots,
+                     max_len=sspec.max_len, kv_layout="dense",
+                     cache_dtype=sspec.cache_dtype,
+                     prompt_lens=sspec.prompt_lens)
+
+
 # -- the HBM-bytes-per-tick model ------------------------------------------
 
 def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
@@ -681,6 +695,9 @@ def default_registry_reports() -> list[Report]:
     draft_cfg = _dc.replace(cfg, n_layers=1)
     draft_stages, _, _ = make_gpt_stages(jax.random.key(1), draft_cfg, 1)
     buckets = (4, 8, 12)
+    spec_paged = ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
+                           prefill_chunk=3, prompt_lens=buckets, spec_k=4,
+                           draft_cfg=draft_cfg)
     specs = [
         ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
                   prefill_chunk=3, prompt_lens=buckets),
@@ -690,15 +707,22 @@ def default_registry_reports() -> list[Report]:
         # the speculative pair (draft propose + batched verify + composite
         # tick) on both layouts — TP deployments need a live multi-device
         # mesh, so the CLI/tests cover those where devices exist
-        ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
-                  prefill_chunk=3, prompt_lens=buckets, spec_k=4,
-                  draft_cfg=draft_cfg),
+        spec_paged,
         ServeSpec(cfg, n_slots=4, kv_layout="dense", prompt_lens=buckets,
                   spec_k=4, draft_cfg=draft_cfg),
     ]
-    return [lint_serve(stages, s, draft_stages=(draft_stages
-                                                if s.spec_k else None))
-            for s in specs]
+    reports = [lint_serve(stages, s, draft_stages=(draft_stages
+                                                   if s.spec_k else None))
+               for s in specs]
+    # the serve supervisor's degraded-fallback layout, derived from the
+    # full speculative deployment by the SAME rule engine_factory applies
+    # on a chaos-driven rebuild — explicitly named so the gate output
+    # shows the fallback was proven, not assumed
+    reports.append(lint_serve(
+        stages, degraded_spec(spec_paged),
+        name=f"serve[degraded fallback of paged spec_k={spec_paged.spec_k}"
+             f": dense slots={spec_paged.n_slots} tp=1 spec_k=0]"))
+    return reports
 
 
 def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:

@@ -202,6 +202,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "emitting 1..K tokens; greedy streams stay "
                         "bit-exact vs solo decode. 0 = plain one-token "
                         "decode; K >= 2 enables the draft/verify tick")
+    g.add_argument('--serve-chaos', type=str, default=None, metavar='SPEC',
+                   help="with --serve-sim: serve under a deterministic "
+                        "fault schedule through the crash-restartable "
+                        "serve supervisor (serve/supervisor.py) — on an "
+                        "injected engine-crash/wedged-device the engine "
+                        "is rebuilt and every in-flight request recovers "
+                        "BIT-EXACT from the fsync'd request journal "
+                        "(resume from the last journaled token, key "
+                        "stream intact). Same grammar as --chaos, e.g. "
+                        "'engine-crash@serve.tick=5'; sites serve.tick "
+                        "and serve.admit")
+    g.add_argument('--serve-deadline-ms', type=float, default=0.0,
+                   metavar='D',
+                   help="with --serve-sim: per-request completion "
+                        "deadline in ms, enforced by the serve "
+                        "supervisor at tick boundaries — an expired "
+                        "request is SHED with a structured rejection and "
+                        "its slot/block budget refunded (0 = no "
+                        "deadline). The run exits 0 when every request "
+                        "either completed or was structurally shed")
+    g.add_argument('--serve-max-restarts', type=int, default=3,
+                   help="with --serve-chaos: engine-rebuild budget before "
+                        "the serve supervisor fails the run loudly")
     g.add_argument('--text-corpus', default=None, metavar="PATH",
                    help="for --model=gpt: train on the BYTES of this local "
                         "file (vocab=256, next-byte LM, contiguous "
@@ -687,6 +710,22 @@ def _run_serve(args, n_stages: int, key) -> None:
     if args.serve_spec_k == 1 or args.serve_spec_k < 0:
         raise SystemExit(f"--serve-spec-k must be 0 (plain decode) or "
                          f">= 2, got {args.serve_spec_k}")
+    if args.serve_deadline_ms < 0:
+        raise SystemExit(f"--serve-deadline-ms must be >= 0 (0 = none), "
+                         f"got {args.serve_deadline_ms}")
+    if args.serve_max_restarts < 0:
+        raise SystemExit(f"--serve-max-restarts must be >= 0, got "
+                         f"{args.serve_max_restarts}")
+    serve_plan = None
+    if args.serve_chaos:
+        from simple_distributed_machine_learning_tpu.resilience import (
+            faults,
+        )
+        try:
+            serve_plan = faults.FaultPlan.parse(args.serve_chaos)
+        except ValueError as e:
+            raise SystemExit(f"bad --serve-chaos spec: {e}") from None
+    supervised = bool(args.serve_chaos or args.serve_deadline_ms)
     cfg = GPTConfig(vocab=256 if args.text_corpus else 128)
     if cfg.n_heads % args.serve_tp:
         raise SystemExit(f"--serve-tp {args.serve_tp} must divide the "
@@ -782,12 +821,43 @@ def _run_serve(args, n_stages: int, key) -> None:
         print("| serve: fresh-initialized params"
               + (f" (no checkpoint at {ckpt})" if ckpt else ""))
     metrics = ServeMetrics(outdir=args.telemetry_dir)
-    engine = InferenceEngine(
-        stages, serve_cfg, params=params, n_slots=args.serve_slots,
+    engine_kw = dict(
+        params=params, n_slots=args.serve_slots,
         block_size=args.serve_block_size,
         prefill_chunk=(args.serve_prefill_chunk or None),
         metrics=metrics, mesh=mesh, draft_stages=draft_stages,
         draft_cfg=draft_cfg, spec_k=args.serve_spec_k)
+    tmpdir = None
+    if supervised:
+        # the crash-restartable path: the engine lives behind the serve
+        # supervisor — journaled submissions/tokens, engine rebuild +
+        # journal recovery on injected faults, deadline shedding
+        import tempfile
+
+        from simple_distributed_machine_learning_tpu.serve import (
+            ServeSupervisor,
+            engine_factory,
+        )
+        if args.telemetry_dir:
+            journal_path = os.path.join(args.telemetry_dir,
+                                        "journal.jsonl")
+            if os.path.exists(journal_path):
+                os.unlink(journal_path)        # each --serve-sim run is fresh
+        else:
+            tmpdir = tempfile.TemporaryDirectory(prefix="sdml-serve-")
+            journal_path = os.path.join(tmpdir.name, "journal.jsonl")
+        engine = ServeSupervisor(
+            engine_factory(stages, serve_cfg, **engine_kw), journal_path,
+            metrics=metrics, max_restarts=args.serve_max_restarts,
+            default_deadline_s=(args.serve_deadline_ms / 1e3
+                                if args.serve_deadline_ms else None))
+        print(f"| serve: supervised (journal {journal_path}"
+              + (f", chaos {args.serve_chaos!r}" if args.serve_chaos
+                 else "")
+              + (f", deadline {args.serve_deadline_ms:g} ms"
+                 if args.serve_deadline_ms else "") + ")")
+    else:
+        engine = InferenceEngine(stages, serve_cfg, **engine_kw)
     max_new = min(args.serve_max_new, cfg.seq_len - longest)
     if max_new < args.serve_max_new:
         print(f"| serve: --serve-max-new {args.serve_max_new} clamped to "
@@ -797,7 +867,37 @@ def _run_serve(args, n_stages: int, key) -> None:
                     seed=args.seed, prompt_lens=GPT_SERVE_PROMPTS,
                     max_new_tokens=max_new,
                     shared_prefix_len=args.serve_shared_prefix)
-    report = simulate(engine, sim)
+    # graceful shutdown: SIGTERM/SIGINT stop admission, drain in-flight
+    # requests, flush metrics + journal and exit 0 — the operational
+    # complement of crash recovery (a rollout must not look like a fault)
+    import signal
+
+    stop = {"sig": None}
+
+    def _on_signal(signum, frame):
+        stop["sig"] = signum
+
+    old_handlers = {}
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            old_handlers[s] = signal.signal(s, _on_signal)
+    except ValueError:
+        old_handlers = {}              # not the main thread: no handlers
+    if serve_plan is not None:
+        from simple_distributed_machine_learning_tpu.resilience import (
+            faults,
+        )
+        faults.install(serve_plan)
+    try:
+        report = simulate(engine, sim,
+                          should_stop=lambda: stop["sig"] is not None)
+    finally:
+        if serve_plan is not None:
+            faults.uninstall()
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+        if supervised:
+            engine.close()             # journal flushed + closed
     s = metrics.summary()
     print(f"| serve: {report['completed']}/{report['n_requests']} requests "
           f"completed, {s['tokens_generated']} tokens, "
@@ -805,6 +905,16 @@ def _run_serve(args, n_stages: int, key) -> None:
           f"ttft p50/p95 {s['ttft_ms_p50']}/{s['ttft_ms_p95']} ms, "
           f"tpot p50/p95 {s['tpot_ms_p50']}/{s['tpot_ms_p95']} ms, "
           f"occupancy {s['slot_occupancy_mean']}")
+    if supervised:
+        print(f"| serve: supervisor {engine.state}, "
+              f"{s.get('restarts', 0)} restart(s), "
+              f"{s.get('recovered_requests', 0)} recovered, "
+              f"{report['shed']} shed {s.get('shed_by_reason', {})}, "
+              f"journal {s.get('journal_bytes', 0)} bytes")
+    if report["stopped"]:
+        print(f"| serve: graceful shutdown on signal {stop['sig']} — "
+              f"admission stopped, {report['submitted']} submitted "
+              f"request(s) drained, metrics/journal flushed")
     print(f"| serve: paged pool {s['blocks_in_use']}/{s['blocks_total']} "
           f"blocks in use ({s['blocks_cached']} cached), "
           f"{s['kv_bytes_resident']} KV bytes resident, "
@@ -824,7 +934,14 @@ def _run_serve(args, n_stages: int, key) -> None:
                             "block_size": args.serve_block_size,
                             "shared_prefix": args.serve_shared_prefix,
                             "completed": report["completed"]})
-    if not report["all_completed"]:
+    if tmpdir is not None:
+        tmpdir.cleanup()
+    # success = every SUBMITTED request accounted for: completed, or (a
+    # deadline run) structurally shed — a silently lost request fails.
+    # A graceful shutdown judges only what was admitted before the signal.
+    expected = (report["submitted"] if report["stopped"]
+                else report["n_requests"])
+    if report["completed"] + report["shed"] != expected:
         raise SystemExit(1)
 
 
@@ -860,10 +977,14 @@ def _run_scenario(args, n_stages: int, key) -> None:
     stages, _wd, _os = make_gpt_stages(key, cfg, n_stages)
     report = run_scenario(args.scenario, stages, cfg,
                           outdir=args.telemetry_dir)
-    print(f"| scenario {report['scenario']} ({report['scheduler']}): "
+    print(f"| scenario {report['scenario']} ({report['scheduler']}"
+          + (", supervised" if report.get("supervised") else "") + "): "
           f"{report['completed']}/{report['n_requests']} completed, "
+          f"{report['shed']} shed, "
           f"{report.get('preemptions', 0)} preemptions, "
-          f"faults fired: "
+          + (f"{report['restarts']} restart(s), "
+             if report.get("supervised") else "")
+          + f"faults fired: "
           f"{report.get('faults', {}).get('total_fired', 0)}")
     for cls, att in sorted(report["slo"].items()):
         parts = []

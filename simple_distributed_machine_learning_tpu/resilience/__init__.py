@@ -35,6 +35,7 @@ _EXPORTS = {
     "HostLost": ".faults",
     "DeviceWedged": ".faults",
     "CheckpointWriteCrash": ".faults",
+    "EngineCrash": ".faults",
     "CheckpointStore": ".store",
     "ElasticTrainer": ".supervisor",
     "PeerLost": ".supervisor",

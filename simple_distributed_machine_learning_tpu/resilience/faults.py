@@ -31,6 +31,12 @@ Fault kinds and their standard effects (applied by :func:`maybe_fire`):
                      write-then-rename discipline must survive
 ``wedged-device``    raises :class:`DeviceWedged`; ``bench.py`` maps it
                      onto the rc-17 wedged-accelerator signature
+``engine-crash``     raises :class:`EngineCrash` — the inference engine's
+                     in-process death (a tick or an admission dying with
+                     its pool state): the serve supervisor
+                     (``serve/supervisor.py``) discards the engine
+                     wholesale and re-admits in-flight requests from the
+                     request journal
 =================== ==================================================
 
 Injection sites threaded through the stack:
@@ -38,6 +44,9 @@ Injection sites threaded through the stack:
 - ``train.step``          (``train/trainer.py``, ctx: ``step``)
 - ``ckpt.write``          (``train/checkpoint.py``, ctx: ``path``, ``tmp``)
 - ``serve.tick``          (``serve/engine.py``, ctx: ``step`` = tick index)
+- ``serve.admit``         (``serve/engine.py::submit``, ctx: ``step`` = rid —
+                          a crash while a request is being accepted, the
+                          journaled-but-never-admitted corner)
 - ``watchdog.heartbeat``  (``utils/failure.py``, ctx: ``rank``)
 - ``bench.probe``         (``bench.py``, ctx: ``step`` = probe attempt)
 
@@ -63,10 +72,10 @@ import os
 import time
 
 KINDS = ("host-kill", "frozen-peer", "slow-tick", "ckpt-write-crash",
-         "wedged-device")
+         "wedged-device", "engine-crash")
 
-SITES = ("train.step", "ckpt.write", "serve.tick", "watchdog.heartbeat",
-         "bench.probe")
+SITES = ("train.step", "ckpt.write", "serve.tick", "serve.admit",
+         "watchdog.heartbeat", "bench.probe")
 
 ENV_VAR = "SDML_CHAOS"
 
@@ -95,6 +104,12 @@ class DeviceWedged(FaultInjected):
 class CheckpointWriteCrash(FaultInjected):
     """The process crashed mid-checkpoint-write (injected): the temp file is
     truncated; the previously committed checkpoint must stay intact."""
+
+
+class EngineCrash(FaultInjected):
+    """The inference engine died mid-tick or mid-admission (injected): its
+    pool buffers and host bookkeeping are gone; the serve supervisor must
+    rebuild from scratch and recover in-flight requests from the journal."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,6 +251,8 @@ class FaultPlan:
                 raise HostLost(spec, site)
             if spec.kind == "wedged-device":
                 raise DeviceWedged(spec, site)
+            if spec.kind == "engine-crash":
+                raise EngineCrash(spec, site)
             if spec.kind == "ckpt-write-crash":
                 tmp = ctx.get("tmp")
                 if tmp:

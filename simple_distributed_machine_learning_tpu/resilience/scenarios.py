@@ -36,7 +36,28 @@ The catalog (also in docs/ARCHITECTURE.md):
 ``burst-slow-tick``  ``burst-interactive``'s load composed with injected
                      slow-tick device stalls — SLOs must hold through a
                      degraded device
+``crash-serve``      steady traffic with an injected ``engine-crash``
+                     mid-serve: the serve supervisor
+                     (``serve/supervisor.py``) recovers every in-flight
+                     request from the journal — the gate requires ALL
+                     requests complete, ≥ 1 restart actually happened,
+                     and the SLOs held through the restart
+``overload-shed``    a sustained burst at > 1.5x service capacity with
+                     per-class hard deadlines: the supervisor sheds
+                     expired and over-rate work so the interactive class
+                     keeps attaining its SLOs — the gate requires
+                     attainment ≥ 0.9 AND every request accounted for
+                     (completed or structurally shed, none lost); the
+                     no-deadline FCFS baseline fails the same gate
+                     (tests pin both sides on exact numbers)
 =================== =====================================================
+
+Supervised scenarios (``Scenario.supervised``) run through the
+:class:`~..serve.supervisor.ServeSupervisor` — journaled submissions,
+crash recovery, deadline enforcement and :class:`OverloadPolicy`
+admission control — while unsupervised ones drive the engine directly
+(deadlines carried by the workload are then stored but never enforced:
+the baseline).
 """
 
 from __future__ import annotations
@@ -54,6 +75,11 @@ from simple_distributed_machine_learning_tpu.serve.simulator import (
     SimConfig,
     TrafficClass,
     simulate,
+)
+from simple_distributed_machine_learning_tpu.serve.supervisor import (
+    OverloadPolicy,
+    ServeSupervisor,
+    engine_factory,
 )
 
 
@@ -90,6 +116,21 @@ class Scenario:
     scheduler: str = "priority"        # "fcfs" | "priority"
     chaos: str | None = None           # FaultPlan.parse spec, or None
     min_attainment: float = 0.9        # per-SLO pass bar
+    # supervised scenarios run through the ServeSupervisor (journal, crash
+    # recovery, deadline enforcement, overload admission control)
+    supervised: bool = False
+    max_restarts: int = 3
+    degrade_after: int | None = None
+    overload: OverloadPolicy | None = None
+    # allow_shed: a request structurally shed (deadline/backpressure/class)
+    # counts as ACCOUNTED FOR — the gate then requires completed + shed ==
+    # n_requests instead of all-completed (overload scenarios shed by
+    # design; losing a request silently still fails)
+    allow_shed: bool = False
+    # the chaos gate: the run must have restarted at least this many times
+    # (a crash scenario whose fault never fired must FAIL, not pass
+    # vacuously — the FaultSpec site check's dynamic twin)
+    min_restarts: int = 0
 
     def __post_init__(self):
         if self.scheduler not in ("fcfs", "priority"):
@@ -98,6 +139,15 @@ class Scenario:
         if not 0 < self.min_attainment <= 1:
             raise ValueError(f"min_attainment must be in (0, 1], got "
                              f"{self.min_attainment}")
+        if self.min_restarts and not self.supervised:
+            raise ValueError(
+                "min_restarts needs supervised=True (only the supervisor "
+                "restarts an engine)")
+        if (self.overload is not None or self.allow_shed) \
+                and not self.supervised:
+            raise ValueError(
+                "overload/allow_shed need supervised=True (admission "
+                "control and shedding live in the supervisor)")
 
 
 # SLO targets are VIRTUAL milliseconds (see module docstring): an engine
@@ -154,24 +204,76 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
                                dataclasses.replace(_BATCH, weight=0.65))),
         n_slots=3, prefill_chunk=4,
         chaos="slow-tick@serve.tick,dur=0.004,after=5,times=10"),
+    Scenario(
+        name="crash-serve",
+        description="steady interactive traffic with an engine crash "
+                    "injected mid-serve: the serve supervisor re-admits "
+                    "every in-flight request from the journal bit-exact "
+                    "and the SLOs hold through the restart (gate: all "
+                    "complete AND >= 1 restart actually happened)",
+        sim=SimConfig(n_requests=16, rate=12.0, seed=0,
+                      classes=(dataclasses.replace(_INTERACTIVE,
+                                                   weight=1.0),)),
+        n_slots=4, prefill_chunk=4, scheduler="fcfs",
+        supervised=True, chaos="engine-crash@serve.tick=6",
+        min_restarts=1),
+    Scenario(
+        name="overload-shed",
+        description="a sustained burst at > 1.5x service capacity with "
+                    "per-class hard deadlines: the supervisor sheds "
+                    "expired/over-budget work (deadline + queue-depth "
+                    "backpressure) so the interactive class keeps "
+                    "attaining its SLOs; the no-deadline FCFS baseline "
+                    "fails the same gate",
+        sim=SimConfig(n_requests=36, rate=40.0, seed=0, arrival="bursty",
+                      burst_factor=5.0, burst_duty=0.3, period_s=1.0,
+                      classes=(
+                          # the hard deadline sits BELOW the SLO target:
+                          # anything not started by 75 vms sheds, so every
+                          # SERVED interactive request starts within the
+                          # 100 vms target with a tick of slack to spare
+                          dataclasses.replace(_INTERACTIVE,
+                                              ttft_slo_ms=100.0,
+                                              ttft_deadline_ms=75.0,
+                                              deadline_ms=500.0),
+                          dataclasses.replace(_BATCH, weight=0.65,
+                                              deadline_ms=1500.0))),
+        n_slots=2, prefill_chunk=4,
+        supervised=True, allow_shed=True,
+        # queue cap + the load-degraded hysteresis: past 6 queued the
+        # supervisor locks best-effort (priority 0) traffic out entirely
+        # until the backlog drains to 2 — graceful degradation before the
+        # interactive class starves
+        overload=OverloadPolicy(max_queue_depth=8,
+                                degrade_queue_depth=6,
+                                recover_queue_depth=2,
+                                degraded_priority_floor=0)),
 )}
 
 
 def run_scenario(scenario: Scenario | str, stages, cfg, *,
                  outdir: str | None = None, scheduler: str | None = None,
-                 virtual: bool = True, per_call_s: float = 0.001) -> dict:
+                 virtual: bool = True, per_call_s: float = 0.001,
+                 supervised: bool | None = None) -> dict:
     """Run one scenario end to end; returns the report with the SLO block.
 
     ``stages``/``cfg``: a ``make_gpt_stages`` build (the engine's usual
     contract). ``scheduler`` overrides the scenario's policy (the
-    FCFS-vs-priority comparison tests use this). With ``outdir`` set, the
-    serve record and a ``kind: "scenario"`` record (name, SLO attainment
-    per class, ``slo_ok``, fault stats) land in ``metrics.jsonl`` +
-    ``metrics.prom`` — the artifact CI's chaos job parses.
+    FCFS-vs-priority comparison tests use this); ``supervised`` overrides
+    whether the run goes through the :class:`ServeSupervisor` — forcing
+    ``False`` on a deadline-carrying scenario IS the no-deadline baseline
+    the overload gate compares against. With ``outdir`` set, the serve
+    record and a ``kind: "scenario"`` record (name, SLO attainment per
+    class, ``slo_ok``, restart/shed counts, fault stats) land in
+    ``metrics.jsonl`` + ``metrics.prom`` — the artifact CI's chaos job
+    parses.
 
     ``report["slo_ok"]`` is True only when every gated class attains every
-    target at ``min_attainment`` or better AND all requests completed.
+    target at ``min_attainment`` or better AND every request is accounted
+    for — completed, or (``allow_shed`` scenarios) structurally shed — AND
+    a supervised run restarted at least ``min_restarts`` times.
     """
+    import tempfile
     import time
 
     if isinstance(scenario, str):
@@ -184,28 +286,65 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     sleep = clock.sleep if virtual else time.sleep
     policy = scheduler or scenario.scheduler
     sched_cls = PriorityScheduler if policy == "priority" else FCFSScheduler
+    sup_flag = scenario.supervised if supervised is None else supervised
 
     plan = None
     if scenario.chaos:
         plan = faults.install(faults.FaultPlan.parse(scenario.chaos,
                                                      sleep=sleep))
+    target = None
+    tmpdir = None
     try:
         from simple_distributed_machine_learning_tpu.serve.engine import (
             InferenceEngine,
         )
         metrics = ServeMetrics(outdir=outdir, clock=clock)
-        engine = InferenceEngine(
-            stages, cfg, n_slots=scenario.n_slots,
-            block_size=scenario.block_size,
-            prefill_chunk=scenario.prefill_chunk,
-            scheduler=sched_cls, metrics=metrics, clock=clock)
-        report = simulate(engine, scenario.sim, sleep=sleep)
+        engine_kw = dict(n_slots=scenario.n_slots,
+                         block_size=scenario.block_size,
+                         prefill_chunk=scenario.prefill_chunk,
+                         scheduler=sched_cls, metrics=metrics, clock=clock)
+        if sup_flag:
+            if outdir:
+                jpath = os.path.join(outdir,
+                                     f"journal-{scenario.name}.jsonl")
+                if os.path.exists(jpath):
+                    os.unlink(jpath)           # each run journals fresh
+            else:
+                tmpdir = tempfile.TemporaryDirectory(prefix="sdml-journal-")
+                jpath = os.path.join(tmpdir.name, "journal.jsonl")
+            from simple_distributed_machine_learning_tpu.serve.journal import (  # noqa: E501
+                RequestJournal,
+            )
+            target = ServeSupervisor(
+                engine_factory(stages, cfg, **engine_kw),
+                # virtual-clock runs measure scheduling structure, not
+                # durability: skip the per-record fsync (journal.py's own
+                # sync=False designation for exactly this case)
+                RequestJournal(jpath, sync=not virtual),
+                metrics=metrics, clock=clock,
+                max_restarts=scenario.max_restarts,
+                degrade_after=scenario.degrade_after,
+                overload=scenario.overload)
+        else:
+            target = InferenceEngine(stages, cfg, **engine_kw)
+        report = simulate(target, scenario.sim, sleep=sleep)
     finally:
         if plan is not None:
             faults.uninstall()
+        if sup_flag and target is not None:
+            target.close()
+        if tmpdir is not None:
+            tmpdir.cleanup()
 
+    n = scenario.sim.n_requests
+    accounted = report["completed"] + (report["shed"]
+                                       if scenario.allow_shed else 0)
     slo: dict = {}
-    ok = bool(report["all_completed"])
+    ok = accounted == n
+    if sup_flag:
+        report["restarts"] = target.restarts
+        report["supervisor_state"] = target.state
+        ok &= target.restarts >= scenario.min_restarts
     for tc in scenario.sim.classes:
         if tc.ttft_slo_ms is None and tc.tpot_slo_ms is None:
             continue
@@ -221,6 +360,7 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
         ok &= cls_ok
     report["scenario"] = scenario.name
     report["scheduler"] = policy
+    report["supervised"] = sup_flag
     report["slo"] = slo
     report["slo_ok"] = ok
     if plan is not None:
@@ -233,8 +373,10 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                             "completed": report["completed"]})
         append_jsonl(os.path.join(outdir, "metrics.jsonl"), {
             "kind": "scenario", "scenario": scenario.name,
-            "scheduler": policy, "completed": report["completed"],
+            "scheduler": policy, "supervised": sup_flag,
+            "completed": report["completed"], "shed": report["shed"],
             "n_requests": report["n_requests"], "slo": slo, "slo_ok": ok,
+            **({"restarts": report["restarts"]} if sup_flag else {}),
             **({"faults_fired": plan.stats()["total_fired"]}
                if plan is not None else {}),
         })

@@ -30,7 +30,19 @@ checkpoint restore, and the telemetry registry:
   at a configurable rate driving the engine (``cli.py --serve-sim``);
 - :mod:`.metrics` — serving telemetry on the PR-4 ``MetricsRegistry``:
   queue-depth / slot-occupancy gauges, TTFT and per-output-token latency
-  histograms, aggregate tokens/sec — JSONL + Prometheus.
+  histograms, aggregate tokens/sec — JSONL + Prometheus;
+- :mod:`.journal` — the append-only, fsync'd request journal (one record
+  per submission / emitted token / completion / shed, carrying live PRNG
+  key state), with a corruption-tolerant tail like the checkpoint store's
+  ``latest_valid``;
+- :mod:`.supervisor` — :class:`ServeSupervisor`: the crash-restartable
+  serving loop (RUNNING → RECOVERING → RUNNING | DEGRADED) that rebuilds a
+  failed engine and re-admits in-flight requests from the journal
+  BIT-EXACT through the preempt/resume machinery, enforces per-request
+  TTFT/total deadlines at tick boundaries, and applies
+  :class:`OverloadPolicy` admission control (per-class token buckets,
+  queue-depth backpressure, degraded modes) — ``cli.py --serve-chaos`` /
+  ``--serve-deadline-ms``.
 
 Correctness anchor (tests/test_serve.py): with the same seed, every
 request's tokens are bit-exact vs decoding it alone through
@@ -39,7 +51,11 @@ optimization, not a math change.
 """
 
 from simple_distributed_machine_learning_tpu.serve.engine import (  # noqa: F401
+    DrainTimeout,
     InferenceEngine,
+)
+from simple_distributed_machine_learning_tpu.serve.journal import (  # noqa: F401
+    RequestJournal,
 )
 from simple_distributed_machine_learning_tpu.serve.metrics import (  # noqa: F401
     ServeMetrics,
@@ -59,4 +75,9 @@ from simple_distributed_machine_learning_tpu.serve.simulator import (  # noqa: F
 from simple_distributed_machine_learning_tpu.serve.slots import (  # noqa: F401
     KVCachePool,
     PagedKVPool,
+)
+from simple_distributed_machine_learning_tpu.serve.supervisor import (  # noqa: F401
+    OverloadPolicy,
+    ServeSupervisor,
+    engine_factory,
 )

@@ -51,6 +51,7 @@ from simple_distributed_machine_learning_tpu.serve.request import (
     ACTIVE,
     DONE,
     QUEUED,
+    SHED,
     Request,
     validate_request,
 )
@@ -66,6 +67,24 @@ from simple_distributed_machine_learning_tpu.serve.slots import (
 # anything > 1 disables top-p
 _NO_TOP_K = 0
 _NO_TOP_P = 2.0
+
+
+class DrainTimeout(RuntimeError):
+    """``drain(max_ticks=...)`` hit its cap with requests still in flight.
+
+    Carries the abandoned work: ``unfinished`` is the list of live
+    :class:`Request` handles (queued + active) at the moment the cap hit,
+    so a caller can requeue, shed or report them instead of silently
+    losing whatever the return value didn't include."""
+
+    def __init__(self, max_ticks: int, unfinished: list):
+        states = collections.Counter(r.state for r in unfinished)
+        super().__init__(
+            f"drain exceeded {max_ticks} ticks with {len(unfinished)} "
+            f"unfinished request(s) ({dict(states)}) — rids "
+            f"{[r.rid for r in unfinished]}")
+        self.max_ticks = max_ticks
+        self.unfinished = unfinished
 
 
 class InferenceEngine:
@@ -309,26 +328,41 @@ class InferenceEngine:
                top_k: int | None = None, top_p: float | None = None,
                eos_id: int | None = None, seed: int | None = None,
                on_token=None, arrival_time: float | None = None,
-               cls: str | None = None, priority: int = 0) -> Request:
+               cls: str | None = None, priority: int = 0,
+               ttft_deadline_s: float | None = None,
+               deadline_s: float | None = None) -> Request:
         """Enqueue one request; returns its live handle immediately.
 
         ``arrival_time`` backdates ``submit_time`` to when the request
         actually ARRIVED (the open-loop simulator's Poisson timestamp), so
         TTFT absorbs queue wait accrued while the engine was inside a tick
         — without it, arrival-to-submit wait would silently vanish from
-        the headline latency exactly in the overload regime."""
+        the headline latency exactly in the overload regime.
+
+        ``ttft_deadline_s``/``deadline_s`` are stored on the handle; the
+        serve SUPERVISOR enforces them at tick boundaries (an unsupervised
+        engine is the no-deadline baseline)."""
         import jax
 
+        # fault-injection site: a crash while the request is being accepted
+        # (journaled by the supervisor but never admitted — the recovery
+        # corner serve/supervisor.py re-admits from the journal alone)
+        maybe_fire("serve.admit", step=self._next_rid)
         prompt = np.asarray(prompt, np.int32)
         validate_request(prompt, max_new_tokens, temperature, top_k, top_p,
                          self.cfg.vocab, self.max_len)
+        for name, v in (("ttft_deadline_s", ttft_deadline_s),
+                        ("deadline_s", deadline_s)):
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
         rid = self._next_rid
         self._next_rid += 1
         seed = rid if seed is None else seed
         r = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     eos_id=eos_id, seed=seed, on_token=on_token,
-                    cls=cls, priority=priority)
+                    cls=cls, priority=priority,
+                    ttft_deadline_s=ttft_deadline_s, deadline_s=deadline_s)
         # the request's independent key stream — the SAME key a solo
         # make_cached_decoder call would be handed, so streams align
         r.key_data = np.asarray(jax.random.key_data(jax.random.key(seed)))
@@ -424,16 +458,91 @@ class InferenceEngine:
         if self.metrics is not None:
             self.metrics.on_preempt(r.cls)
 
+    def cancel(self, rid: int, reason: str = "cancelled") -> Request:
+        """Remove a live request NOW with a structured rejection: a queued
+        request leaves the queue, an active one frees its slot and (paged)
+        decrefs its table blocks and returns its unused reservation — the
+        full budget refund, same release path as retirement — and the
+        handle lands in ``SHED`` with ``finish_reason = reason``. The
+        supervisor's deadline/overload shedding calls this; metrics
+        accounting is the CALLER's job (it knows the reason taxonomy)."""
+        r = self.requests[rid]
+        if r.state not in (QUEUED, ACTIVE):
+            raise ValueError(
+                f"request {rid} is {r.state!r} — only queued/active "
+                f"requests cancel")
+        if r.state == ACTIVE:
+            try:
+                self._prefilling.remove(rid)    # may be mid-prefill
+            except ValueError:
+                pass
+            self.pool.unbind_seq(r.slot)
+            self.pool.release(r.slot)
+            r.slot = None
+            r.prefill_pos = None
+        else:
+            # identity scan, not deque.remove: Request's dataclass __eq__
+            # would compare prompt arrays between same-rid duplicates
+            for i, q in enumerate(self.scheduler.queue):
+                if q is r:
+                    del self.scheduler.queue[i]
+                    break
+            else:               # pragma: no cover - state-machine guard
+                raise RuntimeError(
+                    f"queued request {rid} missing from the scheduler "
+                    f"queue — lifecycle bookkeeping corrupted")
+        r.state = SHED
+        r.finish_reason = reason
+        r.done_time = self._clock()
+        self._last_emit.pop(rid, None)
+        return r
+
+    def restore(self, request: Request) -> Request:
+        """Re-admit a journal-recovered request into THIS engine (the serve
+        supervisor's rebuild path): the handle keeps its rid, emitted
+        tokens and live key stream, re-enters the queue and — exactly like
+        a PR-7 preemption victim — re-prefills ``resume_seq`` on boarding
+        with the sample and key advance discarded, reseating on its stored
+        newest token, so the continued decode is bit-exact vs the
+        uninterrupted run. Callers re-admit in rid order to preserve FCFS
+        arrival order across the restart."""
+        import jax
+
+        if request.rid in self.requests:
+            raise ValueError(f"request {request.rid} already lives in this "
+                             f"engine — restore() is for rebuilt engines")
+        validate_request(request.prompt, request.max_new_tokens,
+                         request.temperature, request.top_k, request.top_p,
+                         self.cfg.vocab, self.max_len)
+        request.state = QUEUED
+        request.slot = None
+        request.prefill_pos = None
+        if request.key_data is None:
+            # never emitted a token: the stream starts where submit's would
+            request.key_data = np.asarray(
+                jax.random.key_data(jax.random.key(request.seed)))
+        if self.speculative and request.draft_key_data is None:
+            request.draft_key_data = np.asarray(jax.random.key_data(
+                jax.random.fold_in(jax.random.key(request.seed), 1)))
+        self.requests[request.rid] = request
+        self._next_rid = max(self._next_rid, request.rid + 1)
+        self.scheduler.enqueue(request)
+        return request
+
     def drain(self, max_ticks: int | None = None) -> list[Request]:
         """Tick until idle (or ``max_ticks``); returns finished requests in
-        completion order is not guaranteed — use ``handle.tokens``."""
+        completion order is not guaranteed — use ``handle.tokens``.
+
+        Hitting the cap with work still in flight raises
+        :class:`DrainTimeout` carrying the unfinished request handles —
+        abandoned requests are a loud, structured signal, never a
+        silently shorter return value (tests/test_serve.py pins it)."""
         ticks = 0
         while self.busy:
             if max_ticks is not None and ticks >= max_ticks:
-                raise RuntimeError(
-                    f"drain exceeded {max_ticks} ticks with "
-                    f"{self.scheduler.queue_depth} queued / "
-                    f"{self.pool.n_active} active — a request is stuck")
+                raise DrainTimeout(max_ticks, [
+                    r for r in self.requests.values()
+                    if r.state in (QUEUED, ACTIVE)])
             self.step()
             ticks += 1
         return [r for r in self.requests.values() if r.state == DONE]

@@ -1,0 +1,246 @@
+"""The append-only request journal: serving's crash-durable source of truth.
+
+The training side survives a crash because every epoch lands in a validated
+:class:`~..resilience.store.CheckpointStore`; the serving side's equivalent
+durable state is much smaller — *which requests exist and which tokens they
+have been handed* — and much hotter, so it gets a write-ahead journal
+instead of checkpoints: one fsync'd JSONL line per submission, per emitted
+token (carrying the request's live PRNG key state, so a recovered decode
+continues on the exact key stream), per completion and per shed.  The serve
+supervisor (``serve/supervisor.py``) writes it on the way in and rebuilds
+the whole in-flight picture from it on the way out of a crash — nothing of
+a dead engine's memory is trusted.
+
+Record grammar (one JSON object per line; field names kept short because a
+line is written per token)::
+
+    {"ev":"submit","rid":3,"prompt":[...],"max_new":8,"temp":0.0,
+     "top_k":null,"top_p":null,"eos":null,"seed":3,"cls":"interactive",
+     "prio":2,"ttft_dl":0.08,"dl":0.4,"t":12.5}
+    {"ev":"tok","rid":3,"tok":17,"kd":[123,456],"dkd":null,"t":13.1}
+    {"ev":"done","rid":3,"reason":"length","t":14.0}
+    {"ev":"shed","rid":5,"reason":"deadline","t":14.2}
+    {"ev":"restart","n":1,"degraded":false,"cause":"EngineCrash"}
+
+Corruption tolerance mirrors ``CheckpointStore.latest_valid``: a crash can
+tear at most the tail, so :func:`read_journal` keeps the longest prefix of
+fully valid lines (a line is valid iff it is newline-terminated and parses
+to a JSON object with an ``ev`` field) and reopening for append TRUNCATES
+the file to that prefix — a torn half-line can never corrupt later
+appends.  :func:`recover_state` folds the valid events into per-request
+:class:`~.request.Request` snapshots, including the journaled-but-not-acked
+corner: a request whose last journaled token already finished it (EOS or
+budget) is marked DONE at recovery instead of being re-admitted, so its
+stream is identical whether or not the ``done`` record made it to disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from simple_distributed_machine_learning_tpu.serve.request import (
+    DONE,
+    QUEUED,
+    SHED,
+    Request,
+)
+
+
+def read_journal(path: str) -> tuple[list[dict], int]:
+    """``(events, valid_bytes)`` of the longest valid prefix of ``path``
+    (``([], 0)`` when the file does not exist).  Scanning stops at the
+    FIRST invalid line — everything after a torn write is suspect, exactly
+    like the checkpoint store falling back past a corrupt generation."""
+    if not os.path.exists(path):
+        return [], 0
+    events: list[dict] = []
+    valid = 0
+    with open(path, "rb") as f:
+        raw = f.read()
+    for line in raw.split(b"\n"):
+        # the final segment of a newline-terminated file is b"": stop
+        # cleanly; a non-empty segment without its newline is a torn tail
+        if not line:
+            break
+        if valid + len(line) + 1 > len(raw):
+            break                      # no trailing newline: torn mid-write
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            break
+        if not isinstance(ev, dict) or "ev" not in ev:
+            break
+        events.append(ev)
+        valid += len(line) + 1
+    return events, valid
+
+
+def recover_state(events: list[dict]) -> dict[int, Request]:
+    """Fold journal events into per-request snapshots, keyed by rid.
+
+    Each snapshot is a :class:`Request` carrying the journaled prompt,
+    sampling params, deadlines, emitted tokens and the LIVE key state
+    (``key_data``/``draft_key_data`` from the last token record — what
+    makes the continued decode bit-exact).  ``state`` is ``DONE``/``SHED``
+    for acknowledged requests, ``QUEUED`` for in-flight ones — including a
+    request that crashed mid-prefill (no tokens yet: its stream restarts
+    from the prompt on the seed's own key).  A request whose last journaled
+    token already finished it is promoted to ``DONE`` here (the ``done``
+    record died with the crash; the stream is complete and identical)."""
+    reqs: dict[int, Request] = {}
+    for ev in events:
+        kind = ev["ev"]
+        if kind == "submit":
+            r = Request(
+                rid=int(ev["rid"]),
+                prompt=np.asarray(ev["prompt"], np.int32),
+                max_new_tokens=int(ev["max_new"]),
+                temperature=float(ev["temp"]),
+                top_k=ev["top_k"],
+                top_p=ev["top_p"],
+                eos_id=ev["eos"],
+                seed=int(ev["seed"]),
+                cls=ev["cls"],
+                priority=int(ev["prio"]),
+                ttft_deadline_s=ev["ttft_dl"],
+                deadline_s=ev["dl"])
+            r.submit_time = ev["t"]
+            reqs[r.rid] = r
+        elif kind == "tok":
+            r = reqs[int(ev["rid"])]
+            r.tokens.append(int(ev["tok"]))
+            r.key_data = np.asarray(ev["kd"], np.uint32)
+            if ev.get("dkd") is not None:
+                r.draft_key_data = np.asarray(ev["dkd"], np.uint32)
+            if r.first_token_time is None and ev.get("t") is not None:
+                r.first_token_time = ev["t"]
+        elif kind == "done":
+            r = reqs[int(ev["rid"])]
+            r.state = DONE
+            r.finish_reason = ev["reason"]
+            r.done_time = ev.get("t")
+        elif kind == "shed":
+            r = reqs[int(ev["rid"])]
+            r.state = SHED
+            r.finish_reason = ev["reason"]
+            r.done_time = ev.get("t")
+        # "restart" records are observability only
+    for r in reqs.values():
+        if r.state == QUEUED and r.tokens:
+            reason = r.finished_by(r.tokens[-1])
+            if reason is not None:
+                # the not-acked corner: finished at the crash boundary
+                r.state = DONE
+                r.finish_reason = reason
+    return reqs
+
+
+class RequestJournal:
+    """One serving run's journal file, opened for durable appends.
+
+    Opening an existing path first truncates it to its longest valid
+    prefix (:func:`read_journal`) — the previous process's torn tail is
+    discarded BEFORE anything new lands after it.  ``sync=True`` (default)
+    fsyncs every append: a record the supervisor acted on is on disk, the
+    property the recovery guarantees rest on.  ``sync=False`` keeps the
+    write-ordering guarantees (flush per append) without the disk round
+    trip — for tests and virtual-clock scenario runs where the OS page
+    cache is durability enough.
+    """
+
+    def __init__(self, path: str, sync: bool = True) -> None:
+        self.path = path
+        self.sync = sync
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        events, valid = read_journal(path)
+        self._recovered_events = events
+        if os.path.exists(path) and os.path.getsize(path) != valid:
+            with open(path, "r+b") as f:
+                f.truncate(valid)
+        self._f = open(path, "ab")
+        self.bytes = valid
+
+    # -- write side --------------------------------------------------------
+
+    def append(self, ev: dict) -> None:
+        line = (json.dumps(ev, separators=(",", ":")) + "\n").encode()
+        self._f.write(line)
+        self._f.flush()
+        if self.sync:
+            os.fsync(self._f.fileno())
+        self.bytes += len(line)
+
+    def log_submit(self, *, rid: int, prompt, max_new: int, temp: float,
+                   top_k, top_p, eos, seed: int, cls, prio: int,
+                   ttft_dl, dl, t) -> None:
+        self.append({"ev": "submit", "rid": rid,
+                     "prompt": [int(x) for x in np.asarray(prompt)],
+                     "max_new": int(max_new), "temp": float(temp),
+                     "top_k": top_k, "top_p": top_p, "eos": eos,
+                     "seed": int(seed), "cls": cls, "prio": int(prio),
+                     "ttft_dl": ttft_dl, "dl": dl, "t": t})
+
+    def log_token(self, request: Request, token: int) -> None:
+        """One emitted token WITH the request's post-emit key state (the
+        engine updates ``key_data`` before ``emit`` fires the callback, so
+        at call time the fields are exactly what the continuation needs).
+        ``t`` rides only on the first token — it restores
+        ``first_token_time`` (the TTFT endpoint) across a recovery.
+
+        Speculative-tick caveat: a tick that accepts several tokens emits
+        them all under the tick's single post-verify key state, so those
+        records share one ``kd`` — a SAMPLED speculative stream is
+        therefore recoverable at tick granularity only.  Every in-process
+        recovery path (the injected faults fire at tick boundaries) and
+        every greedy stream (greedy consumes no key splits at all) stays
+        exactly bit-exact; the one exposure is a hard process kill landing
+        BETWEEN two fsyncs of the same sampled speculative tick, where a
+        cold restart resumes that request deterministically but off the
+        tick-atomic key sequence."""
+        dkd = request.draft_key_data
+        self.append({
+            "ev": "tok", "rid": request.rid, "tok": int(token),
+            "kd": [int(x) for x in np.asarray(request.key_data)],
+            "dkd": None if dkd is None else [int(x) for x in
+                                             np.asarray(dkd)],
+            **({"t": request.first_token_time}
+               if len(request.tokens) == 1 else {})})
+
+    def log_done(self, *, rid: int, reason: str, t=None) -> None:
+        self.append({"ev": "done", "rid": rid, "reason": reason, "t": t})
+
+    def log_shed(self, *, rid: int, reason: str, t=None) -> None:
+        self.append({"ev": "shed", "rid": rid, "reason": reason, "t": t})
+
+    def log_restart(self, n: int, degraded: bool, cause: str) -> None:
+        self.append({"ev": "restart", "n": int(n),
+                     "degraded": bool(degraded), "cause": cause})
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
+            self._f.close()
+
+    # -- read side ---------------------------------------------------------
+
+    @property
+    def recovered_events(self) -> list[dict]:
+        """The valid events found on disk when this journal was OPENED —
+        the cold-start recovery input (empty for a fresh file)."""
+        return self._recovered_events
+
+    def recovered_state(self) -> dict[int, Request]:
+        """Re-read the file from disk and fold it into request snapshots —
+        the crash-recovery entry point.  Deliberately NOT served from
+        in-process memory: recovery must believe only what an fsync made
+        durable, or the bit-exactness claim is about the wrong state."""
+        self._f.flush()
+        events, _ = read_journal(self.path)
+        return recover_state(events)

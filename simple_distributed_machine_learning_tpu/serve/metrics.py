@@ -49,6 +49,21 @@ scenario suite's per-class SLO accounting, ``resilience/scenarios.py``):
   ``serve_preemptions_total`` — how often priority scheduling evicted
   best-effort traffic to protect an interactive class.
 
+Crash-restart + overload-control instruments (fed by the serve supervisor,
+``serve/supervisor.py``):
+
+- ``serve_restarts_total`` (counter) — engine rebuilds after a recoverable
+  failure; ``serve_recovered_requests_total`` (counter) — in-flight
+  requests re-admitted from the journal across those restarts;
+- ``serve_shed_total{reason=deadline|backpressure|class}`` (counter) and
+  ``serve_class_shed_total{class=...}`` — structured rejections: expired
+  deadlines, queue-depth backpressure, per-class token-bucket/degraded
+  lockout;
+- ``serve_degraded`` (gauge, 0/1) — whether the supervisor is in a
+  degraded mode (fallback engine layout after repeated crashes, or the
+  overload best-effort lockout);
+- ``serve_journal_bytes`` (gauge) — the request journal's durable size.
+
 ``emit()`` writes one ``kind: "serve"`` record to ``metrics.jsonl`` and
 refreshes ``metrics.prom`` — the same two artifact formats the training
 telemetry session emits, so one scrape config covers both.
@@ -121,6 +136,14 @@ class ServeMetrics:
         self._shape_seen = False
         self._spec_seen = False
         self.preemptions = r.counter("serve_preemptions_total")
+        # crash-restart + overload-control instruments (the supervisor's
+        # hooks; the summary's resilience block appears once any fires)
+        self.restarts_total = r.counter("serve_restarts_total")
+        self.recovered_total = r.counter("serve_recovered_requests_total")
+        self.degraded_gauge = r.gauge("serve_degraded")
+        self.journal_bytes_gauge = r.gauge("serve_journal_bytes")
+        self._shed_reasons: dict[str, object] = {}
+        self._resilience_seen = False
         self._classes: set[str] = set()
         if outdir:
             os.makedirs(outdir, exist_ok=True)
@@ -158,6 +181,38 @@ class ServeMetrics:
         self.preemptions.inc()
         if cls is not None:
             self._class_counter("serve_class_preemptions_total", cls).inc()
+
+    # -- supervisor hooks (crash restart + overload control) ---------------
+
+    def on_restart(self) -> None:
+        self._resilience_seen = True
+        self.restarts_total.inc()
+
+    def on_recovered(self, n: int) -> None:
+        """``n`` in-flight requests re-admitted from the journal."""
+        self._resilience_seen = True
+        if n:
+            self.recovered_total.inc(n)
+
+    def on_shed(self, reason: str, cls: str | None = None) -> None:
+        """One structured rejection; ``reason`` is the label value
+        (``deadline`` | ``backpressure`` | ``class``)."""
+        self._resilience_seen = True
+        counter = self._shed_reasons.get(reason)
+        if counter is None:
+            counter = self._shed_reasons[reason] = self.registry.counter(
+                "serve_shed_total", labels={"reason": reason})
+        counter.inc()
+        if cls is not None:
+            self._class_counter("serve_class_shed_total", cls).inc()
+
+    def set_degraded(self, degraded) -> None:
+        self._resilience_seen = True
+        self.degraded_gauge.set(int(bool(degraded)))
+
+    def set_journal_bytes(self, n: int) -> None:
+        self._resilience_seen = True
+        self.journal_bytes_gauge.set(int(n))
 
     def _on_any_token(self) -> None:
         self.tokens.inc()
@@ -246,6 +301,8 @@ class ServeMetrics:
             "preemptions": int(
                 self._class_counter("serve_class_preemptions_total",
                                     cls).value),
+            "shed": int(
+                self._class_counter("serve_class_shed_total", cls).value),
             "ttft_ms_p50": r3(ttft.quantile(0.5)),
             "ttft_ms_p95": r3(ttft.quantile(0.95)),
             "tpot_ms_p50": r3(tpot.quantile(0.5)),
@@ -299,6 +356,18 @@ class ServeMetrics:
             })
         if self.preemptions.value:
             out["preemptions"] = int(self.preemptions.value)
+        if self._resilience_seen:
+            shed = {reason: int(c.value)
+                    for reason, c in sorted(self._shed_reasons.items())
+                    if c.value}
+            out.update({
+                "restarts": int(self.restarts_total.value),
+                "recovered_requests": int(self.recovered_total.value),
+                "shed_total": sum(shed.values()),
+                "shed_by_reason": shed,
+                "degraded": int(self.degraded_gauge.value),
+                "journal_bytes": int(self.journal_bytes_gauge.value),
+            })
         if self._classes:
             out["per_class"] = {cls: self.class_summary(cls)
                                 for cls in sorted(self._classes)}

@@ -2,7 +2,10 @@
 
 One :class:`Request` is one user sequence moving through the engine:
 ``QUEUED`` (waiting for a slot) → ``ACTIVE`` (owns a KV-cache slot, decoding)
-→ ``DONE`` (EOS emitted or ``max_new_tokens`` reached; slot freed). Sampling
+→ ``DONE`` (EOS emitted or ``max_new_tokens`` reached; slot freed), or →
+``SHED`` (the overload/deadline exit: the supervisor cancelled it with a
+structured rejection in ``finish_reason`` — ``deadline``, ``backpressure``
+or ``class`` — and its slot/block budget was refunded). Sampling
 config is per-request — greedy (``temperature=0``) or temperature sampling
 with optional top-k / top-p filtering — with an independent key stream seeded
 from ``seed``, so two requests never share randomness and each one's tokens
@@ -23,6 +26,7 @@ import numpy as np
 QUEUED = "queued"
 ACTIVE = "active"
 DONE = "done"
+SHED = "shed"
 
 
 @dataclasses.dataclass
@@ -46,6 +50,14 @@ class Request:
     # serving metrics only.
     cls: str | None = None
     priority: int = 0
+    # deadlines, in seconds RELATIVE to submit_time: ``ttft_deadline_s``
+    # bounds time-to-first-token, ``deadline_s`` bounds the whole request.
+    # The ENGINE only stores them; enforcement (shed at tick boundaries,
+    # budget refunded) is the serve supervisor's job — an unsupervised
+    # engine is the "no-deadline baseline" the overload scenarios compare
+    # against (serve/supervisor.py).
+    ttft_deadline_s: float | None = None
+    deadline_s: float | None = None
 
     # -- lifecycle (engine-owned) -----------------------------------------
     state: str = QUEUED
@@ -63,7 +75,9 @@ class Request:
     submit_time: float | None = None
     first_token_time: float | None = None
     done_time: float | None = None
-    finish_reason: str | None = None    # "eos" | "length"
+    # "eos" | "length", or the SHED reasons "deadline" | "backpressure"
+    # | "class"
+    finish_reason: str | None = None
     # preemption accounting: a preempted request goes back to QUEUED with
     # its emitted tokens intact; re-admission recomputes its K/V from
     # `resume_seq` WITHOUT touching the key stream, so the continued decode

@@ -28,7 +28,7 @@ import numpy as np
 from simple_distributed_machine_learning_tpu.serve.engine import (
     InferenceEngine,
 )
-from simple_distributed_machine_learning_tpu.serve.request import DONE
+from simple_distributed_machine_learning_tpu.serve.request import DONE, SHED
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +43,13 @@ class TrafficClass:
     registry (``resilience/scenarios.py``). ``max_new_tokens``/
     ``prompt_lens`` override the SimConfig-wide workload mix per class
     (batch tenants decode long, interactive ones short).
+
+    ``ttft_deadline_ms``/``deadline_ms`` are HARD per-request deadlines
+    (distinct from the SLO *targets* above, which only grade a run): each
+    submission carries them, and a supervised engine
+    (``serve/supervisor.py``) SHEDS a request that exceeds one, refunding
+    its budget. An unsupervised engine stores but never enforces them —
+    the no-deadline baseline the overload scenarios compare against.
     """
 
     name: str
@@ -52,6 +59,8 @@ class TrafficClass:
     tpot_slo_ms: float | None = None
     max_new_tokens: int | None = None
     prompt_lens: tuple | None = None
+    ttft_deadline_ms: float | None = None
+    deadline_ms: float | None = None
 
     def __post_init__(self):
         if not self.name:
@@ -234,20 +243,34 @@ def build_workload(sim: SimConfig, vocab: int) -> tuple[np.ndarray, list]:
         if cls is not None:
             spec["cls"] = cls.name
             spec["priority"] = cls.priority
+            if cls.ttft_deadline_ms is not None:
+                spec["ttft_deadline_s"] = cls.ttft_deadline_ms / 1e3
+            if cls.deadline_ms is not None:
+                spec["deadline_s"] = cls.deadline_ms / 1e3
         specs.append(spec)
     return arrivals, specs
 
 
 def simulate(engine: InferenceEngine, sim: SimConfig,
-             clock=None, sleep=time.sleep) -> dict:
+             clock=None, sleep=time.sleep, should_stop=None) -> dict:
     """Run the open-loop trace through ``engine``; returns the report dict
     (pure JSON-serializable — the live request handles stay reachable via
     ``engine.requests``, keyed by rid in submit order).
+
+    ``engine`` may equally be a :class:`~.supervisor.ServeSupervisor` —
+    it duck-types the same surface; supervised runs additionally report
+    shed requests (structured rejections) under ``"shed"``.
 
     ``clock`` defaults to the ENGINE's clock so arrival timestamps (which
     become ``submit_time`` for TTFT) and the engine's first-token stamps
     share one origin; override only with a clock the engine was also
     constructed with.
+
+    ``should_stop`` is the graceful-shutdown hook (``cli.py --serve-sim``'s
+    SIGTERM/SIGINT handler): once it returns truthy, admission stops —
+    remaining arrivals are never submitted — and the loop DRAINS every
+    in-flight request before returning (``report["stopped"]`` is True,
+    ``report["submitted"]`` counts what actually entered the engine).
 
     The loop: submit every request whose arrival time has passed, tick the
     engine while anything is in flight, sleep (briefly) only when idle
@@ -259,7 +282,16 @@ def simulate(engine: InferenceEngine, sim: SimConfig,
     handles = []
     start = clock()
     i = 0
+    stopped = False
     while i < sim.n_requests or engine.busy:
+        if not stopped and should_stop is not None and should_stop():
+            stopped = True
+        if stopped:
+            # graceful shutdown: no new admissions, drain what's in flight
+            if not engine.busy:
+                break
+            engine.step()
+            continue
         t = clock() - start
         while i < sim.n_requests and arrivals[i] <= t:
             # submit_time = the ARRIVAL timestamp, not "now": wait accrued
@@ -273,11 +305,15 @@ def simulate(engine: InferenceEngine, sim: SimConfig,
             sleep(min(max(arrivals[i] - (clock() - start), 0.0), 0.05))
     wall_s = clock() - start
     completed = sum(1 for h in handles if h.state == DONE)
+    shed = sum(1 for h in handles if h.state == SHED)
     report = {
         "n_requests": sim.n_requests,
         "rate": sim.rate,
+        "submitted": len(handles),
         "completed": completed,
+        "shed": shed,
         "all_completed": completed == sim.n_requests,
+        "stopped": stopped,
         "wall_s": round(wall_s, 3),
         "requests": [
             {"rid": h.rid, "prompt_len": int(h.prompt.shape[0]),

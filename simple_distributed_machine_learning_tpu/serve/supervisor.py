@@ -1,0 +1,570 @@
+"""The serve supervisor: crash-restartable serving with overload control.
+
+PR 7 made *training* elastic; this module gives the inference engine the
+same production shape.  :class:`ServeSupervisor` wraps an
+:class:`~.engine.InferenceEngine` behind the engine's own duck-typed
+surface (``submit``/``step``/``drain``/``busy``/``requests``), adding the
+three things a single-process engine lacks:
+
+**Crash recovery (RUNNING → RECOVERING → RUNNING | DEGRADED).**  Every
+submission and every emitted token is journaled (``serve/journal.py``,
+fsync'd, with the request's live PRNG key state riding on each token
+record).  A recoverable engine failure — an injected ``engine-crash`` /
+``wedged-device`` / ``host-kill`` at the ``serve.tick`` or ``serve.admit``
+sites, or anything else in :data:`RECOVERABLE` leaking out of a tick —
+discards the engine wholesale, rebuilds a fresh one through the caller's
+``factory(degraded)`` and re-admits every in-flight request *from the
+journal alone* through the PR-7 preempt/resume machinery: re-admission
+prefills ``resume_seq = prompt + tokens[:-1]`` with the sample and key
+advance discarded, then reseats on the last journaled token with the
+journaled key state — so a request's full token stream equals the
+uninterrupted run's, across any number of restarts (double crashes, i.e.
+a crash during recovery, included).  ``max_restarts`` bounds the loop
+(:class:`~..resilience.supervisor.RestartBudgetExceeded`), and
+``degrade_after`` restarts flips later rebuilds to the DEGRADED layout —
+:func:`engine_factory`'s rule: speculation off, tensor parallelism off,
+dense slot rows (the same transform ``analysis.programs.degraded_spec``
+keeps lint-clean in the program registry).
+
+**Deadlines.**  ``submit(..., ttft_deadline_s=, deadline_s=)`` (or the
+supervisor-wide defaults) bound time-to-first-token and total latency.
+Expired requests are shed at tick boundaries with a structured rejection
+(``state = SHED``, ``finish_reason = "deadline"``) and their slot/block
+budget refunded the same release path retirement uses — an expired
+request never occupies capacity a live one could use.
+
+**Overload control.**  :class:`OverloadPolicy` gates admission before the
+engine sees a request: per-class token buckets (``class_rates``) police
+each tenant's arrival rate, ``max_queue_depth`` bounds the queue (a
+higher-priority arrival sheds the lowest-priority newest queued victim
+first; otherwise the arrival itself is shed), and sustained overload
+(queue depth past ``degrade_queue_depth``, with hysteresis) enters the
+load-degraded mode where best-effort traffic (priority ≤
+``degraded_priority_floor``) is refused outright — graceful degradation
+before any SLO class starves.  Every shed lands in
+``serve_shed_total{reason=deadline|backpressure|class}``.
+
+Delivery semantics across a crash: the token LIST on a handle is
+exactly-once (recovery truncates to the journaled prefix and the decode
+re-emits the identical tokens); the ``on_token`` callback is at-least-once
+at crash boundaries (a token emitted between the journal write and the
+client ack replays).  Sampled SPECULATIVE streams add one caveat: a
+multi-token speculative tick journals under the tick's single key state,
+so their cold-restart recovery is tick-atomic (``journal.py::log_token``'s
+caveat note) — every in-process recovery and every greedy stream is
+unconditionally bit-exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from simple_distributed_machine_learning_tpu.resilience.faults import (
+    DeviceWedged,
+    EngineCrash,
+    HostLost,
+)
+from simple_distributed_machine_learning_tpu.serve.journal import (
+    RequestJournal,
+)
+from simple_distributed_machine_learning_tpu.serve.request import (
+    ACTIVE,
+    DONE,
+    QUEUED,
+    SHED,
+    Request,
+    validate_request,
+)
+
+# supervisor states (the machine in the module docstring / ARCHITECTURE.md)
+RUNNING = "running"
+RECOVERING = "recovering"
+DEGRADED = "degraded"
+FAILED = "failed"
+
+#: engine failures the supervisor restarts through — the engine (pool
+#: buffers + host bookkeeping) is rebuilt from scratch and in-flight
+#: requests recover from the journal.  Anything else is a bug in the
+#: serving code and propagates un-retried.
+RECOVERABLE = (EngineCrash, DeviceWedged, HostLost)
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadPolicy:
+    """Admission-control knobs; ``OverloadPolicy()`` disables them all.
+
+    ``class_rates`` maps a traffic-class name to a ``(rate_per_s, burst)``
+    token bucket — submissions beyond the bucket shed with reason
+    ``"class"``.  ``max_queue_depth`` bounds the scheduler queue: at the
+    bound, an arrival strictly higher-priority than some queued request
+    sheds the lowest-priority newest-queued victim (reason
+    ``"backpressure"``) and boards; otherwise the arrival itself sheds.
+    ``degrade_queue_depth``/``recover_queue_depth`` are the load-degraded
+    hysteresis: past the high watermark, requests at priority ≤
+    ``degraded_priority_floor`` are refused (reason ``"class"``) until the
+    queue drains to the low watermark."""
+
+    max_queue_depth: int | None = None
+    class_rates: dict | None = None
+    degrade_queue_depth: int | None = None
+    recover_queue_depth: int = 0
+    degraded_priority_floor: int = 0
+
+    def __post_init__(self):
+        if self.max_queue_depth is not None and self.max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1, got "
+                             f"{self.max_queue_depth}")
+        if self.degrade_queue_depth is not None:
+            if self.degrade_queue_depth < 1:
+                raise ValueError(f"degrade_queue_depth must be >= 1, got "
+                                 f"{self.degrade_queue_depth}")
+            if self.recover_queue_depth >= self.degrade_queue_depth:
+                raise ValueError(
+                    f"recover_queue_depth {self.recover_queue_depth} must "
+                    f"sit below degrade_queue_depth "
+                    f"{self.degrade_queue_depth} (hysteresis, not a "
+                    f"flapping threshold)")
+        for cls, rb in (self.class_rates or {}).items():
+            rate, burst = rb
+            if rate <= 0 or burst < 1:
+                raise ValueError(
+                    f"class {cls!r}: token bucket needs rate > 0 and "
+                    f"burst >= 1, got ({rate}, {burst})")
+
+
+def engine_factory(stages, cfg, *, metrics=None, clock=time.monotonic,
+                   scheduler=None, mesh=None, draft_stages=None,
+                   draft_cfg=None, spec_k: int = 0, **kw):
+    """The standard ``factory(degraded) -> InferenceEngine`` closure.
+
+    Non-degraded builds get the full deployment (paged knobs, TP mesh,
+    speculative draft) exactly as passed; ``degraded=True`` applies the
+    fallback rule — ``spec_k → 0``, ``tp → 1``, dense slot rows — the
+    layout ``analysis.programs.degraded_spec`` mirrors so the program
+    registry proves the fallback lint-clean before any crash needs it.
+    The fallback stays bit-exact for everything except *sampled* requests
+    that were being served speculatively (dense vs paged vs plain-decode
+    streams all equal the solo decode; sampled speculative streams are
+    deterministic but consume the key streams differently).
+
+    ``scheduler`` must be a CLASS/factory (each rebuilt engine constructs
+    its own instance over its own pool); ``metrics``/``clock`` are shared
+    across rebuilds so counters and timelines stay continuous.
+    """
+    from simple_distributed_machine_learning_tpu.serve.engine import (
+        InferenceEngine,
+    )
+
+    def factory(degraded: bool) -> InferenceEngine:
+        if not degraded:
+            return InferenceEngine(
+                stages, cfg, metrics=metrics, clock=clock,
+                scheduler=scheduler, mesh=mesh, draft_stages=draft_stages,
+                draft_cfg=draft_cfg, spec_k=spec_k, **kw)
+        dcfg = cfg
+        if getattr(cfg, "n_tensor_parallel", 1) > 1:
+            dcfg = dataclasses.replace(cfg, n_tensor_parallel=1)
+        dkw = {k: v for k, v in kw.items()
+               if k not in ("block_size", "n_blocks", "prefill_chunk",
+                            "kv_layout")}
+        return InferenceEngine(stages, dcfg, kv_layout="dense",
+                               metrics=metrics, clock=clock,
+                               scheduler=scheduler, **dkw)
+
+    return factory
+
+
+class ServeSupervisor:
+    """Crash-restartable, deadline- and overload-aware serving; see the
+    module docstring.  Duck-types the engine surface the simulator and the
+    scenario runner drive (``submit``/``step``/``drain``/``busy``/
+    ``requests``/``metrics``/``cfg``/``_clock``)."""
+
+    def __init__(self, factory, journal, *, metrics=None,
+                 clock=time.monotonic, max_restarts: int = 3,
+                 degrade_after: int | None = None,
+                 overload: OverloadPolicy | None = None,
+                 default_ttft_deadline_s: float | None = None,
+                 default_deadline_s: float | None = None) -> None:
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got "
+                             f"{max_restarts}")
+        if degrade_after is not None and degrade_after < 1:
+            raise ValueError(f"degrade_after must be >= 1 restarts, got "
+                             f"{degrade_after}")
+        self.factory = factory
+        self.journal = (RequestJournal(journal) if isinstance(journal, str)
+                        else journal)
+        self.metrics = metrics
+        self._clock = clock
+        self.max_restarts = int(max_restarts)
+        self.degrade_after = degrade_after
+        self.overload = overload if overload is not None else OverloadPolicy()
+        self.default_ttft_deadline_s = default_ttft_deadline_s
+        self.default_deadline_s = default_deadline_s
+        self.restarts = 0
+        self.degraded = False        # fault-driven: rebuilds use the fallback
+        self.load_degraded = False   # overload-driven: best-effort lockout
+        self.state = RUNNING
+        self.requests: dict[int, Request] = {}
+        self._open: set[int] = set()           # submitted, not DONE/SHED
+        self._user_cb: dict[int, object] = {}  # rid -> caller's on_token
+        self._buckets: dict[str, tuple[float, float]] = {}
+        self.engine = factory(False)
+        # cold start: a previous process's journal recovers here — its
+        # completed streams become readable handles, its in-flight requests
+        # re-admit and continue bit-exact (no restart consumed: the budget
+        # guards THIS process's engine, not history)
+        snapshots = self.journal.recovered_state()
+        if snapshots:
+            self._reseat(snapshots, note_recovered=True)
+
+    # -- the engine surface -------------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        return self.engine.busy
+
+    @property
+    def cfg(self):
+        return self.engine.cfg
+
+    @property
+    def scheduler(self):
+        return self.engine.scheduler
+
+    @property
+    def pool(self):
+        return self.engine.pool
+
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               top_k: int | None = None, top_p: float | None = None,
+               eos_id: int | None = None, seed: int | None = None,
+               on_token=None, arrival_time: float | None = None,
+               cls: str | None = None, priority: int = 0,
+               ttft_deadline_s: float | None = None,
+               deadline_s: float | None = None) -> Request:
+        """Admission-controlled, journaled submit.  The returned handle may
+        already be ``SHED`` (a structured rejection — the request never
+        reached the engine); otherwise the submission is journaled BEFORE
+        the engine sees it, so even a crash inside admission recovers it."""
+        now = self._clock() if arrival_time is None else arrival_time
+        if ttft_deadline_s is None:
+            ttft_deadline_s = self.default_ttft_deadline_s
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        prompt = np.asarray(prompt, np.int32)
+        # validate BEFORE journaling: a rejected submission must not leave
+        # a journal entry recovery would forever fail to re-admit
+        validate_request(prompt, max_new_tokens, temperature, top_k, top_p,
+                         self.engine.cfg.vocab, self.engine.max_len)
+        rid = self.engine._next_rid      # the rid engine.submit will assign
+        seed = rid if seed is None else seed
+        reason = self._admission_check(cls, priority, now)
+        if reason is not None:
+            return self._shed_at_admission(
+                rid, prompt, max_new_tokens, temperature, top_k, top_p,
+                eos_id, seed, cls, priority, ttft_deadline_s, deadline_s,
+                reason, now)
+        self._user_cb[rid] = on_token
+        self.journal.log_submit(
+            rid=rid, prompt=prompt, max_new=max_new_tokens,
+            temp=temperature, top_k=top_k, top_p=top_p, eos=eos_id,
+            seed=seed, cls=cls, prio=priority, ttft_dl=ttft_deadline_s,
+            dl=deadline_s, t=now)
+        try:
+            r = self.engine.submit(
+                prompt, max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_id=eos_id, seed=seed,
+                on_token=self._on_token, arrival_time=now, cls=cls,
+                priority=priority, ttft_deadline_s=ttft_deadline_s,
+                deadline_s=deadline_s)
+        except RECOVERABLE as e:
+            # the serve.admit crash: the journal already carries this
+            # submission, so recovery rebuilds and re-admits it
+            self._recover(e)
+            return self.requests[rid]
+        assert r.rid == rid, (r.rid, rid)
+        self.requests[rid] = r
+        self._open.add(rid)
+        return r
+
+    def step(self) -> int:
+        """One supervised tick: deadline shedding, then the engine tick
+        (recoverable failures recover in place), then completion acks."""
+        self._shed_expired()
+        try:
+            emitted = self.engine.step()
+        except RECOVERABLE as e:
+            self._recover(e)
+            emitted = 0
+        self._ack_done()
+        self._update_load_degraded()   # a draining backlog lifts the mode
+        #                                even if no further arrival probes it
+        if self.metrics is not None:
+            self.metrics.set_journal_bytes(self.journal.bytes)
+        return emitted
+
+    def drain(self, max_ticks: int | None = None) -> list[Request]:
+        from simple_distributed_machine_learning_tpu.serve.engine import (
+            DrainTimeout,
+        )
+        ticks = 0
+        while self.busy:
+            if max_ticks is not None and ticks >= max_ticks:
+                raise DrainTimeout(max_ticks, [
+                    r for r in self.requests.values()
+                    if r.state in (QUEUED, ACTIVE)])
+            self.step()
+            ticks += 1
+        return [r for r in self.requests.values() if r.state == DONE]
+
+    def close(self) -> None:
+        self.journal.close()
+
+    # -- overload control ---------------------------------------------------
+
+    def _admission_check(self, cls, priority: int, now: float) -> str | None:
+        """The shed reason for this arrival, or None to admit.  May itself
+        shed a queued lower-priority victim to make room.  The class
+        bucket is PEEKED first but debited only once every other gate
+        passed — an arrival shed for backpressure must not charge its
+        class for capacity it never used."""
+        ov = self.overload
+        self._update_load_degraded()
+        if self.load_degraded and priority <= ov.degraded_priority_floor:
+            return "class"
+        if not self._bucket_peek(cls, now):
+            return "class"
+        if (ov.max_queue_depth is not None
+                and self.engine.scheduler.queue_depth >= ov.max_queue_depth):
+            victim = self._backpressure_victim(priority)
+            if victim is None:
+                return "backpressure"
+            self._shed_live(victim, "backpressure")
+        self._bucket_debit(cls)
+        return None
+
+    def _update_load_degraded(self) -> None:
+        """The load-degraded hysteresis, from the CURRENT queue depth —
+        called at admission AND every tick, so the mode cannot latch on
+        after the backlog drains just because arrivals stopped."""
+        ov = self.overload
+        if ov.degrade_queue_depth is None:
+            return
+        qd = self.engine.scheduler.queue_depth
+        if not self.load_degraded and qd >= ov.degrade_queue_depth:
+            self.load_degraded = True
+            self._note_degraded()
+        elif self.load_degraded and qd <= ov.recover_queue_depth:
+            self.load_degraded = False
+            self._note_degraded()
+
+    def _bucket_peek(self, cls, now: float) -> bool:
+        """Refill the class's bucket to ``now`` and report affordability
+        WITHOUT consuming — the refill is monotone so storing it early is
+        harmless, the debit is not."""
+        rates = self.overload.class_rates
+        if not rates or cls not in rates:
+            return True
+        rate, burst = rates[cls]
+        tokens, last = self._buckets.get(cls, (float(burst), now))
+        tokens = min(float(burst), tokens + max(0.0, now - last) * rate)
+        self._buckets[cls] = (tokens, now)
+        return tokens >= 1.0
+
+    def _bucket_debit(self, cls) -> None:
+        rates = self.overload.class_rates
+        if not rates or cls not in rates:
+            return
+        tokens, last = self._buckets[cls]
+        self._buckets[cls] = (tokens - 1.0, last)
+
+    def _backpressure_victim(self, priority: int) -> Request | None:
+        """Lowest-priority, newest-queued request STRICTLY below the
+        arrival's priority — the cheapest work to discard for room."""
+        best = None
+        for r in self.engine.scheduler.queue:
+            if r.priority >= priority:
+                continue
+            if best is None or (r.priority, -r.rid) < (best.priority,
+                                                       -best.rid):
+                best = r
+        return best
+
+    def _shed_expired(self) -> None:
+        """Deadline enforcement at the tick boundary: TTFT deadlines bind
+        until the first token, total deadlines bind until completion.
+        Shedding refunds the slot/block budget immediately (engine.cancel
+        routes through the same release path as retirement)."""
+        if not any(
+                self.requests[rid].deadline_s is not None
+                or self.requests[rid].ttft_deadline_s is not None
+                for rid in self._open):
+            return
+        now = self._clock()
+        for rid in sorted(self._open):
+            r = self.requests[rid]
+            if r.state not in (QUEUED, ACTIVE):
+                continue
+            expired = (
+                (r.deadline_s is not None
+                 and now - r.submit_time >= r.deadline_s)
+                or (r.ttft_deadline_s is not None
+                    and r.first_token_time is None
+                    and now - r.submit_time >= r.ttft_deadline_s))
+            if expired:
+                self._shed_live(r, "deadline")
+
+    def _shed_live(self, r: Request, reason: str) -> None:
+        self.engine.cancel(r.rid, reason)
+        self.journal.log_shed(rid=r.rid, reason=reason, t=r.done_time)
+        self._open.discard(r.rid)
+        self._user_cb.pop(r.rid, None)
+        if self.metrics is not None:
+            self.metrics.on_shed(reason, cls=r.cls)
+
+    def _shed_at_admission(self, rid, prompt, max_new, temperature, top_k,
+                           top_p, eos_id, seed, cls, priority, ttft_dl, dl,
+                           reason: str, now: float) -> Request:
+        """A structured rejection: the handle exists (state SHED, the
+        reason in ``finish_reason``) but the engine never saw the request.
+        The rid is consumed so the journal's id space stays unique, and
+        both records land so a cold recovery accounts for it."""
+        assert rid == self.engine._next_rid
+        self.engine._next_rid = rid + 1
+        r = Request(rid=rid, prompt=prompt, max_new_tokens=max_new,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    eos_id=eos_id, seed=seed, cls=cls, priority=priority,
+                    ttft_deadline_s=ttft_dl, deadline_s=dl)
+        r.submit_time = now
+        r.done_time = now
+        r.state = SHED
+        r.finish_reason = reason
+        self.journal.log_submit(
+            rid=rid, prompt=prompt, max_new=max_new, temp=temperature,
+            top_k=top_k, top_p=top_p, eos=eos_id, seed=seed, cls=cls,
+            prio=priority, ttft_dl=ttft_dl, dl=dl, t=now)
+        self.journal.log_shed(rid=rid, reason=reason, t=now)
+        self.requests[rid] = r
+        if self.metrics is not None:
+            self.metrics.on_submit()
+            self.metrics.on_shed(reason, cls=cls)
+        return r
+
+    # -- crash recovery -----------------------------------------------------
+
+    def _on_token(self, request: Request, token: int) -> None:
+        """Every engine token flows through here: journal first (the
+        durability point), then the caller's callback — 'journaled but not
+        acked' is the recoverable order, the reverse would lose tokens."""
+        self.journal.log_token(request, token)
+        cb = self._user_cb.get(request.rid)
+        if cb is not None:
+            cb(request, token)
+
+    def _ack_done(self) -> None:
+        for rid in list(self._open):
+            r = self.requests[rid]
+            if r.state == DONE:
+                self.journal.log_done(rid=rid, reason=r.finish_reason,
+                                      t=r.done_time)
+                self._open.discard(rid)
+                self._user_cb.pop(rid, None)
+
+    def _note_degraded(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_degraded(self.degraded or self.load_degraded)
+        if self.state in (RUNNING, DEGRADED):
+            self.state = (DEGRADED if (self.degraded or self.load_degraded)
+                          else RUNNING)
+
+    def _recover(self, exc: BaseException) -> None:
+        """RECOVERING: count the restart against the budget, rebuild the
+        engine (degraded once past ``degrade_after``) and re-admit every
+        in-flight request from the journal alone."""
+        from simple_distributed_machine_learning_tpu.resilience.supervisor import (  # noqa: E501
+            RestartBudgetExceeded,
+        )
+        self.state = RECOVERING
+        self.restarts += 1
+        if self.restarts > self.max_restarts:
+            self.state = FAILED
+            raise RestartBudgetExceeded(
+                f"{self.restarts} engine failures exceed the max_restarts="
+                f"{self.max_restarts} budget; last: "
+                f"{type(exc).__name__}: {exc}") from exc
+        if (self.degrade_after is not None and not self.degraded
+                and self.restarts >= self.degrade_after):
+            self.degraded = True
+        if self.metrics is not None:
+            self.metrics.on_restart()
+        self.journal.log_restart(self.restarts, self.degraded,
+                                 type(exc).__name__)
+        # journal-ONLY reconstruction: nothing of the dead engine's memory
+        # is trusted — exactly the host-kill discipline the trainer has
+        snapshots = self.journal.recovered_state()
+        self.engine = self.factory(self.degraded)
+        self._reseat(snapshots, note_recovered=True)
+        self.state = RUNNING
+        self._note_degraded()    # RUNNING -> DEGRADED when a mode is on
+
+    def _reseat(self, snapshots: dict[int, Request],
+                note_recovered: bool) -> None:
+        """Apply journal snapshots to the live handles (or adopt the
+        snapshots as handles on a cold start) and re-admit the in-flight
+        ones into ``self.engine`` in rid order — FCFS arrival order
+        survives the restart."""
+        if snapshots:
+            # the rebuilt engine's rid space must clear EVERY journaled rid
+            # (done/shed ones included — restore() only bumps past the
+            # re-admitted), or a fresh submission would reuse a dead rid
+            self.engine._next_rid = max(self.engine._next_rid,
+                                        max(snapshots) + 1)
+        inflight = []
+        for rid in sorted(snapshots):
+            snap = snapshots[rid]
+            r = self.requests.get(rid)
+            if r is None:
+                r = snap                     # cold start / mid-submit crash
+                self.requests[rid] = r
+            else:
+                self._apply_snapshot(r, snap)
+            if r.state == QUEUED:
+                inflight.append(r)
+            elif rid in self._open:
+                # finished/shed exactly at the crash boundary: the stream
+                # is already complete and identical — ack it now
+                if r.state == DONE:
+                    self.journal.log_done(rid=rid, reason=r.finish_reason,
+                                          t=r.done_time)
+                self._open.discard(rid)
+                self._user_cb.pop(rid, None)
+        for r in inflight:
+            r.on_token = self._on_token
+            self.engine.restore(r)
+            self._open.add(r.rid)
+        if note_recovered and inflight and self.metrics is not None:
+            self.metrics.on_recovered(len(inflight))
+
+    @staticmethod
+    def _apply_snapshot(r: Request, snap: Request) -> None:
+        """Overwrite a live handle's decode state with the journal's —
+        object identity is preserved (the caller's handle stays live), the
+        STATE is the journal's: tokens truncate to the journaled prefix
+        (the decode re-emits the identical tail), key streams rewind to
+        the last durable token's."""
+        r.tokens[:] = snap.tokens
+        r.key_data = snap.key_data
+        r.draft_key_data = snap.draft_key_data
+        r.submit_time = snap.submit_time
+        r.first_token_time = snap.first_token_time
+        r.slot = None
+        r.prefill_pos = None
+        r.state = snap.state
+        r.finish_reason = snap.finish_reason
+        if snap.done_time is not None:
+            r.done_time = snap.done_time

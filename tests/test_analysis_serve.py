@@ -637,3 +637,59 @@ def test_scatter_variant_primitives_checked():
                    for f_ in bad.findings), (op, bad.format())
         good = analyze(f, x, spec((), np.int32, 0, 3))
         assert good.ok(fail_on="warning"), (op, good.format())
+
+
+# ---- the serve supervisor's degraded-fallback layout ----------------------
+
+def test_degraded_spec_matches_engine_factory_rule(stages):
+    """``degraded_spec`` and ``serve/supervisor.py::engine_factory`` must
+    apply the SAME fallback transform (spec off, tp 1, dense rows) — the
+    registry's degraded entry is only a proof if it describes the engine a
+    chaos-stressed supervisor actually rebuilds."""
+    import dataclasses as _dc
+
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        degraded_spec,
+    )
+    from simple_distributed_machine_learning_tpu.serve.supervisor import (
+        engine_factory,
+    )
+
+    full = ServeSpec(CFG, n_slots=3, max_len=16, kv_layout="paged",
+                     block_size=4, prefill_chunk=3, prompt_lens=BUCKETS,
+                     spec_k=4, draft_cfg=_dc.replace(CFG, n_layers=1))
+    d = degraded_spec(full)
+    assert d.kv_layout == "dense" and d.spec_k == 0 and d.tp == 1
+    assert d.n_slots == full.n_slots and d.ml == full.ml
+    draft_cfg = _dc.replace(CFG, n_layers=1)
+    draft_stages = make_gpt_stages(jax.random.key(1), draft_cfg, 1)[0]
+    eng = engine_factory(stages, CFG, n_slots=3, max_len=16, block_size=4,
+                         prefill_chunk=3, draft_stages=draft_stages,
+                         draft_cfg=draft_cfg, spec_k=4)(True)
+    assert eng.kv_layout == "dense" and not eng.speculative
+    assert eng.tp == 1 and eng.pool.n_slots == 3
+    # and the degraded ENGINE's own lint (the exact programs it built)
+    # is clean: zero trace.failed, zero unproven-promise
+    report = lint_engine(eng, prompt_lens=BUCKETS)
+    assert report.ok(fail_on="warning"), report.format()
+    rules = {f.rule for f in report.findings}
+    assert "trace.failed" not in rules
+    assert "scatter-bounds.unproven-promise" not in rules
+
+
+def test_default_registry_includes_clean_degraded_entry():
+    """The CI ``--serve`` sweep carries an explicitly named degraded-
+    fallback report, and it is clean — the fallback that only exists on
+    the worst day is proven on every PR."""
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        default_registry_reports,
+    )
+
+    reports = default_registry_reports()
+    degraded = [r for r in reports if "degraded" in r.name]
+    assert len(degraded) == 1
+    r = degraded[0]
+    assert r.ok(fail_on="warning"), r.format()
+    rules = {f.rule for f in r.findings}
+    assert "trace.failed" not in rules
+    assert "scatter-bounds.unproven-promise" not in rules

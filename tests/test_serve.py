@@ -241,6 +241,30 @@ def test_request_validation():
     assert r.finished_by(7) is None
 
 
+def test_drain_timeout_reports_unfinished():
+    """The drain cap is a loud, structured signal: hitting ``max_ticks``
+    with work still in flight raises DrainTimeout naming the abandoned
+    request handles (queued AND active), never a silently shorter return
+    value. Requests stay live — a later full drain finishes them."""
+    from simple_distributed_machine_learning_tpu.serve import DrainTimeout
+
+    stages, params = _model()
+    eng = InferenceEngine(stages, CFG, n_slots=1)
+    r1 = eng.submit(_prompt(4, 13), max_new_tokens=8, seed=71)
+    r2 = eng.submit(_prompt(5, 14), max_new_tokens=4, seed=72)
+    with pytest.raises(DrainTimeout) as ei:
+        eng.drain(max_ticks=2)
+    unfinished = ei.value.unfinished
+    assert {r.rid for r in unfinished} == {r1.rid, r2.rid}
+    assert str(r1.rid) in str(ei.value) and "2 ticks" in str(ei.value)
+    # nothing was abandoned for real: draining on finishes both, bit-exact
+    eng.drain()
+    np.testing.assert_array_equal(
+        r1.tokens, _solo(stages, params, r1.prompt, 8, 71))
+    np.testing.assert_array_equal(
+        r2.tokens, _solo(stages, params, r2.prompt, 4, 72))
+
+
 def test_streaming_callback_order():
     stages, params = _model()
     eng = InferenceEngine(stages, CFG, n_slots=1)
