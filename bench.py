@@ -1063,7 +1063,11 @@ def _measure_availability(stages, cfg, slots: int, n_requests: int,
                            block_size=block_size, prefill_chunk=block_size,
                            metrics=metrics),
             os.path.join(tmpdir.name, "journal.jsonl"), metrics=metrics,
-            max_restarts=max_restarts, default_deadline_s=deadline_s)
+            max_restarts=max_restarts, default_deadline_s=deadline_s,
+            # the crash forensics ride along: the injected restart must
+            # leave a post-mortem bundle (flight rows + request states +
+            # journal tail), and the row reports how many were written
+            postmortem_dir=tmpdir.name)
         rng = np.random.default_rng(0)
         t0w = _time.perf_counter()
         for i in range(n_requests):
@@ -1075,6 +1079,7 @@ def _measure_availability(stages, cfg, slots: int, n_requests: int,
         sup.drain()
         sup.close()
         wall = _time.perf_counter() - t0w
+        postmortems = len(sup.postmortems)
     finally:
         faults.uninstall()
         tmpdir.cleanup()
@@ -1090,6 +1095,7 @@ def _measure_availability(stages, cfg, slots: int, n_requests: int,
         "shed_deadline": s.get("shed_by_reason", {}).get("deadline", 0),
         "restarts": s.get("restarts", 0),
         "recovered_requests": s.get("recovered_requests", 0),
+        "postmortem_bundles": postmortems,
         "faults_fired": plan.stats()["total_fired"],
         "wall_s": round(wall, 3),
         "device_kind": jax.devices()[0].device_kind,

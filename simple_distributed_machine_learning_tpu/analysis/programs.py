@@ -607,15 +607,25 @@ def predict_kv_bytes_resident(sspec: ServeSpec, rows_per_seq,
     given live sequences pin, where each entry of ``rows_per_seq`` is one
     sequence's written-row count (``prompt_len + tokens_emitted - 1`` once
     decoding). Assumes no prefix sharing between the sequences — shared
-    blocks make the true gauge strictly smaller. Paged layout only (the
-    dense pool pins everything up front). PER SHARD under TP — the pool's
-    gauge reports per-chip bytes (heads split ``tp`` ways), and this model
-    must agree with it EXACTLY (tests/test_analysis_serve.py)."""
+    blocks make the true gauge strictly smaller, never larger, which is
+    what makes the runtime KV-drift gauge (``serve_kv_drift_bytes`` =
+    live − predicted) a leak detector: 0 without sharing, ≤ 0 with it,
+    and > 0 only if the pool pins blocks the model says it cannot need.
+    Dense layout: ``rows_per_seq`` is ignored — the dense pool pins every
+    row up front, so the prediction is the full allocation. PER SHARD
+    under TP — the pool's gauge reports per-chip bytes (heads split ``tp``
+    ways), and this model must agree with it EXACTLY
+    (tests/test_analysis_serve.py)."""
     from simple_distributed_machine_learning_tpu.serve.slots import (
         kv_block_bytes,
     )
     cfg = sspec.cfg
     L = n_layers if n_layers is not None else cfg.n_layers
+    if sspec.kv_layout == "dense":
+        per_row = kv_block_bytes(L, cfg.n_heads // sspec.tp, sspec.ml,
+                                 cfg.d_model // cfg.n_heads,
+                                 sspec.cache_dtype)
+        return per_row * sspec.n_slots
     per_block = kv_block_bytes(L, cfg.n_heads // sspec.tp, sspec.block_size,
                                cfg.d_model // cfg.n_heads,
                                sspec.cache_dtype)
@@ -725,14 +735,14 @@ def default_registry_reports() -> list[Report]:
     return reports
 
 
-def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:
-    """Preflight a live :class:`~..serve.engine.InferenceEngine`'s EXACT
-    programs — same layout, block geometry, chunk size and cache dtype the
-    engine constructed (``InferenceEngine(lint=True)`` calls this at
-    construction)."""
+def engine_spec(engine, prompt_lens: tuple | None = None) -> ServeSpec:
+    """The :class:`ServeSpec` of a LIVE engine — the one engine->spec
+    mapping (layout, block geometry, chunk size, cache dtype, spec/draft
+    shape) shared by the lint preflight and the runtime KV-drift gauge,
+    so the two can never describe different deployments."""
     pool = engine.pool
     paged = engine.kv_layout == "paged"
-    sspec = ServeSpec(
+    return ServeSpec(
         cfg=engine.cfg, n_slots=pool.n_slots, max_len=engine.max_len,
         kv_layout=engine.kv_layout,
         block_size=pool.block_size if paged else 16,
@@ -741,5 +751,12 @@ def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:
         cache_dtype=pool.kc.dtype, prompt_lens=prompt_lens,
         spec_k=engine.spec_k if engine.speculative else 0,
         draft_cfg=engine.draft_cfg)
-    return lint_serve(engine.stages, sspec, mesh=engine.mesh,
-                      draft_stages=engine.draft_stages)
+
+
+def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:
+    """Preflight a live :class:`~..serve.engine.InferenceEngine`'s EXACT
+    programs — same layout, block geometry, chunk size and cache dtype the
+    engine constructed (``InferenceEngine(lint=True)`` calls this at
+    construction)."""
+    return lint_serve(engine.stages, engine_spec(engine, prompt_lens),
+                      mesh=engine.mesh, draft_stages=engine.draft_stages)

@@ -225,6 +225,18 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--serve-max-restarts', type=int, default=3,
                    help="with --serve-chaos: engine-rebuild budget before "
                         "the serve supervisor fails the run loudly")
+    g.add_argument('--serve-trace', action='store_true',
+                   help="with --serve-sim/--scenario and --telemetry-dir: "
+                        "request-scoped tracing (serve/tracing.py) — a "
+                        "per-rid async span timeline (queue wait, prefill "
+                        "chunks, decode/spec ticks, preempt/resume, crash "
+                        "re-admission) written as serve_trace*.json "
+                        "(chrome://tracing / Perfetto) plus a "
+                        "request_timeline*.jsonl the report CLI reads "
+                        "(python -m ...telemetry.report). Off by default: "
+                        "the hot path pays nothing when disabled, and "
+                        "spans join across supervisor restarts (the "
+                        "journal rid is the trace id)")
     g.add_argument('--text-corpus', default=None, metavar="PATH",
                    help="for --model=gpt: train on the BYTES of this local "
                         "file (vocab=256, next-byte LM, contiguous "
@@ -821,6 +833,15 @@ def _run_serve(args, n_stages: int, key) -> None:
         print("| serve: fresh-initialized params"
               + (f" (no checkpoint at {ckpt})" if ckpt else ""))
     metrics = ServeMetrics(outdir=args.telemetry_dir)
+    trace = None
+    if args.serve_trace:
+        if not args.telemetry_dir:
+            raise SystemExit("--serve-trace needs --telemetry-dir (the "
+                             "trace artifacts land next to metrics.jsonl)")
+        from simple_distributed_machine_learning_tpu.serve import (
+            ServeTrace,
+        )
+        trace = ServeTrace(outdir=args.telemetry_dir)
     engine_kw = dict(
         params=params, n_slots=args.serve_slots,
         block_size=args.serve_block_size,
@@ -850,14 +871,20 @@ def _run_serve(args, n_stages: int, key) -> None:
             engine_factory(stages, serve_cfg, **engine_kw), journal_path,
             metrics=metrics, max_restarts=args.serve_max_restarts,
             default_deadline_s=(args.serve_deadline_ms / 1e3
-                                if args.serve_deadline_ms else None))
+                                if args.serve_deadline_ms else None),
+            trace=trace,
+            # crash forensics whenever artifacts are kept: a post-mortem
+            # bundle per restart / drain-timeout / shed burst next to the
+            # journal (serve/flight.py)
+            postmortem_dir=args.telemetry_dir or None)
         print(f"| serve: supervised (journal {journal_path}"
               + (f", chaos {args.serve_chaos!r}" if args.serve_chaos
                  else "")
               + (f", deadline {args.serve_deadline_ms:g} ms"
                  if args.serve_deadline_ms else "") + ")")
     else:
-        engine = InferenceEngine(stages, serve_cfg, **engine_kw)
+        engine = InferenceEngine(stages, serve_cfg, trace=trace,
+                                 **engine_kw)
     max_new = min(args.serve_max_new, cfg.seq_len - longest)
     if max_new < args.serve_max_new:
         print(f"| serve: --serve-max-new {args.serve_max_new} clamped to "
@@ -898,6 +925,8 @@ def _run_serve(args, n_stages: int, key) -> None:
             signal.signal(s, h)
         if supervised:
             engine.close()             # journal flushed + closed
+        if trace is not None:
+            trace.close()              # chrome trace + timeline flushed
     s = metrics.summary()
     print(f"| serve: {report['completed']}/{report['n_requests']} requests "
           f"completed, {s['tokens_generated']} tokens, "
@@ -911,6 +940,16 @@ def _run_serve(args, n_stages: int, key) -> None:
               f"{s.get('recovered_requests', 0)} recovered, "
               f"{report['shed']} shed {s.get('shed_by_reason', {})}, "
               f"journal {s.get('journal_bytes', 0)} bytes")
+        if engine.postmortems:
+            print(f"| serve: {len(engine.postmortems)} post-mortem "
+                  f"bundle(s): "
+                  f"{[os.path.basename(p) for p in engine.postmortems]}")
+    if "kv_drift_bytes" in s:
+        print(f"| serve: kv drift {s['kv_drift_bytes']} bytes vs the "
+              f"analyzer model (predicted {s['kv_bytes_predicted']})")
+    if trace is not None:
+        print(f"| serve: trace {trace.n_events} events -> "
+              f"{trace.trace_file} + {trace.timeline_file}")
     if report["stopped"]:
         print(f"| serve: graceful shutdown on signal {stop['sig']} — "
               f"admission stopped, {report['submitted']} submitted "
@@ -976,7 +1015,8 @@ def _run_scenario(args, n_stages: int, key) -> None:
     cfg = GPTConfig()
     stages, _wd, _os = make_gpt_stages(key, cfg, n_stages)
     report = run_scenario(args.scenario, stages, cfg,
-                          outdir=args.telemetry_dir)
+                          outdir=args.telemetry_dir,
+                          trace=bool(args.serve_trace))
     print(f"| scenario {report['scenario']} ({report['scheduler']}"
           + (", supervised" if report.get("supervised") else "") + "): "
           f"{report['completed']}/{report['n_requests']} completed, "
@@ -1000,6 +1040,13 @@ def _run_scenario(args, n_stages: int, key) -> None:
                          f"({'-' if a is None else round(a, 3)})")
         print(f"| scenario:   {cls} "
               f"[{'OK' if att['ok'] else 'VIOLATED'}] " + "; ".join(parts))
+    if report.get("postmortem_bundles"):
+        print(f"| scenario: {report['postmortem_bundles']} post-mortem "
+              f"bundle(s) under {args.telemetry_dir}")
+    if report.get("trace_events"):
+        print(f"| scenario: trace {report['trace_events']} events"
+              + (f" under {args.telemetry_dir}" if args.telemetry_dir
+                 else " (in-memory; add --telemetry-dir to keep them)"))
     print(f"| scenario: SLO {'ATTAINED' if report['slo_ok'] else 'MISSED'}")
     if not report["slo_ok"]:
         raise SystemExit(1)

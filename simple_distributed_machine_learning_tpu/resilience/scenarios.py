@@ -254,7 +254,7 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
 def run_scenario(scenario: Scenario | str, stages, cfg, *,
                  outdir: str | None = None, scheduler: str | None = None,
                  virtual: bool = True, per_call_s: float = 0.001,
-                 supervised: bool | None = None) -> dict:
+                 supervised: bool | None = None, trace=None) -> dict:
     """Run one scenario end to end; returns the report with the SLO block.
 
     ``stages``/``cfg``: a ``make_gpt_stages`` build (the engine's usual
@@ -266,7 +266,17 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     record and a ``kind: "scenario"`` record (name, SLO attainment per
     class, ``slo_ok``, restart/shed counts, fault stats) land in
     ``metrics.jsonl`` + ``metrics.prom`` — the artifact CI's chaos job
-    parses.
+    parses; supervised runs additionally write a post-mortem bundle per
+    restart / drain-timeout / shed burst into ``outdir``.
+
+    ``trace`` enables request-scoped tracing (``serve/tracing.py``):
+    ``True`` builds a :class:`~..serve.tracing.ServeTrace` (written to
+    ``outdir`` as ``serve_trace-<name>.json`` + per-request timeline when
+    an outdir is set), or pass a ready recorder. The recorder is fed only
+    timestamps the engine already read, so the virtual clock advances
+    identically with tracing on or off — every exact-pinned scenario
+    number holds either way, and the trace itself is byte-identical
+    across runs (tests pin both).
 
     ``report["slo_ok"]`` is True only when every gated class attains every
     target at ``min_attainment`` or better AND every request is accounted
@@ -294,15 +304,24 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                                                      sleep=sleep))
     target = None
     tmpdir = None
+    own_trace = trace is True      # we built it -> we close its file handle
     try:
         from simple_distributed_machine_learning_tpu.serve.engine import (
             InferenceEngine,
         )
         metrics = ServeMetrics(outdir=outdir, clock=clock)
+        if trace is True:
+            from simple_distributed_machine_learning_tpu.serve.tracing import (  # noqa: E501
+                ServeTrace,
+            )
+            trace = ServeTrace(outdir=outdir,
+                               suffix=f"-{scenario.name}" if outdir else "")
         engine_kw = dict(n_slots=scenario.n_slots,
                          block_size=scenario.block_size,
                          prefill_chunk=scenario.prefill_chunk,
                          scheduler=sched_cls, metrics=metrics, clock=clock)
+        if trace and not sup_flag:
+            engine_kw["trace"] = trace
         if sup_flag:
             if outdir:
                 jpath = os.path.join(outdir,
@@ -324,7 +343,13 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                 metrics=metrics, clock=clock,
                 max_restarts=scenario.max_restarts,
                 degrade_after=scenario.degrade_after,
-                overload=scenario.overload)
+                overload=scenario.overload,
+                trace=trace or None,
+                # crash forensics ride along whenever artifacts do: one
+                # post-mortem bundle per restart / drain-timeout / shed
+                # burst next to the journal (no clock reads — the pinned
+                # numbers cannot move)
+                postmortem_dir=outdir)
         else:
             target = InferenceEngine(stages, cfg, **engine_kw)
         report = simulate(target, scenario.sim, sleep=sleep)
@@ -333,6 +358,12 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
             faults.uninstall()
         if sup_flag and target is not None:
             target.close()
+        if trace and trace is not True:
+            # `trace` stays the bool if setup raised before the recorder
+            # was built — never shadow that original exception. A
+            # caller-owned recorder only flushes (its lifecycle is the
+            # caller's); one we built here closes its timeline handle too
+            trace.close() if own_trace else trace.flush()
         if tmpdir is not None:
             tmpdir.cleanup()
 
@@ -344,7 +375,10 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     if sup_flag:
         report["restarts"] = target.restarts
         report["supervisor_state"] = target.state
+        report["postmortem_bundles"] = len(target.postmortems)
         ok &= target.restarts >= scenario.min_restarts
+    if trace:
+        report["trace_events"] = trace.n_events
     for tc in scenario.sim.classes:
         if tc.ttft_slo_ms is None and tc.tpot_slo_ms is None:
             continue

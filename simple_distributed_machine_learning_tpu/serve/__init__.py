@@ -33,8 +33,18 @@ checkpoint restore, and the telemetry registry:
   histograms, aggregate tokens/sec — JSONL + Prometheus;
 - :mod:`.journal` — the append-only, fsync'd request journal (one record
   per submission / emitted token / completion / shed, carrying live PRNG
-  key state), with a corruption-tolerant tail like the checkpoint store's
-  ``latest_valid``;
+  key state and the supervisor's monotonic tick), with a
+  corruption-tolerant tail like the checkpoint store's ``latest_valid``;
+- :mod:`.tracing` — :class:`ServeTrace`: request-scoped tracing — per-rid
+  async span timelines (submit, queue wait, prefill chunks, decode/spec
+  ticks, preempt/resume, crash re-admission, completion) exported as
+  Chrome-trace async events plus a per-request JSONL timeline; spans join
+  across restarts because the journal's rid is the trace id, and the
+  recorder never reads a clock (engine-supplied stamps only);
+- :mod:`.flight` — :class:`FlightRecorder`: a bounded ring of per-tick
+  engine snapshots, dumped by the supervisor as post-mortem bundles
+  (flight rows + request states + metrics snapshot + journal tail) on
+  every restart, ``DrainTimeout`` and shed burst;
 - :mod:`.supervisor` — :class:`ServeSupervisor`: the crash-restartable
   serving loop (RUNNING → RECOVERING → RUNNING | DEGRADED) that rebuilds a
   failed engine and re-admits in-flight requests from the journal
@@ -53,6 +63,10 @@ optimization, not a math change.
 from simple_distributed_machine_learning_tpu.serve.engine import (  # noqa: F401
     DrainTimeout,
     InferenceEngine,
+)
+from simple_distributed_machine_learning_tpu.serve.flight import (  # noqa: F401
+    FlightRecorder,
+    write_bundle,
 )
 from simple_distributed_machine_learning_tpu.serve.journal import (  # noqa: F401
     RequestJournal,
@@ -80,4 +94,7 @@ from simple_distributed_machine_learning_tpu.serve.supervisor import (  # noqa: 
     OverloadPolicy,
     ServeSupervisor,
     engine_factory,
+)
+from simple_distributed_machine_learning_tpu.serve.tracing import (  # noqa: F401
+    ServeTrace,
 )

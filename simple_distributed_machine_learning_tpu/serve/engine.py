@@ -130,7 +130,7 @@ class InferenceEngine:
                  scheduler: FCFSScheduler | None = None,
                  clock=time.monotonic, lint: bool = False,
                  mesh=None, draft_stages=None, draft_cfg=None,
-                 spec_k: int = 0) -> None:
+                 spec_k: int = 0, trace=None, flight=None) -> None:
         from simple_distributed_machine_learning_tpu.models.gpt import (
             make_paged_block_copy,
             make_paged_decode_step,
@@ -262,7 +262,22 @@ class InferenceEngine:
                     "InferenceEngine(lint=True): the serve-program "
                     "preflight found ERROR findings:\n" + report.format())
         self.metrics = metrics
+        # request-scoped tracing (serve/tracing.py) and the tick flight
+        # recorder (serve/flight.py): both None by default — the hot path
+        # pays exactly one `is None` test per site when disabled, and the
+        # trace recorder is only ever handed timestamps this engine
+        # already read (never a fresh clock read), so enabling it cannot
+        # perturb virtual-clock scenario numbers
+        self.trace = trace
+        self.flight = flight
+        self._n_layers = n_layers
+        self._predict = None     # lazy (ServeSpec, predict_fn) for kv drift
         self._clock = clock
+        # the engine's most recent clock reading — what trace events with
+        # no clock read of their own (paged admission, preemption, crash)
+        # are stamped with; updated at every site that reads the clock
+        # anyway, NEVER by an extra read
+        self._now = 0.0
         self._next_rid = 0
         self._tick_count = 0
         self.requests: dict[int, Request] = {}
@@ -375,10 +390,13 @@ class InferenceEngine:
                 jax.random.fold_in(jax.random.key(seed), 1)))
         r.submit_time = (self._clock() if arrival_time is None
                          else arrival_time)
+        self._now = max(self._now, r.submit_time)
         self.requests[rid] = r
         self.scheduler.enqueue(r)
         if self.metrics is not None:
             self.metrics.on_submit()
+        if self.trace is not None:
+            self.trace.on_submit(r, r.submit_time)
         return r
 
     def step(self) -> int:
@@ -413,13 +431,46 @@ class InferenceEngine:
             emitted += (self._spec_tick(decoding) if self.speculative
                         else self._decode_tick_paged(decoding))
         if self.metrics is not None:
+            live, predicted = self.kv_drift()
             self.metrics.on_tick(
                 self.scheduler.queue_depth, self.pool.n_active,
                 self.pool.n_slots, decode_active=decode_active,
                 block_stats=(self.pool.stats()
                              if self.kv_layout == "paged" else None),
-                tp=self.tp, spec_k=self.spec_k)
+                tp=self.tp, spec_k=self.spec_k,
+                kv_predicted=predicted, kv_drift=live - predicted)
+        if self.flight is not None:
+            self.flight.snap(self, self._tick_count, emitted)
         return emitted
+
+    def kv_drift(self) -> tuple[int, int]:
+        """``(live, predicted)`` resident K/V bytes: the pool's
+        ``serve_kv_bytes_resident`` gauge next to the PR-8 analyzer's
+        ``predict_kv_bytes_resident`` over the live sequences' written-row
+        counts — the static model checked as a RUNTIME invariant every
+        tick. ``live - predicted`` is the drift gauge: exactly 0 without
+        prefix sharing, ≤ 0 with it (sharing only shrinks the truth), and
+        > 0 only if the pool leaks blocks the model says no live sequence
+        can be pinning."""
+        if self._predict is None:
+            from simple_distributed_machine_learning_tpu.analysis.programs import (  # noqa: E501
+                engine_spec,
+                predict_kv_bytes_resident,
+            )
+            # the SAME engine->spec mapping the lint preflight uses, so
+            # the drift check can never describe a different deployment
+            self._predict = (engine_spec(self), predict_kv_bytes_resident)
+        sspec, predict = self._predict
+        rows = []
+        if self.kv_layout == "paged":
+            for s in self.pool.active_slots():
+                r = self.requests[self.pool.occupant(s)]
+                n = (r.prefill_pos if r.prefill_pos is not None
+                     else int(self.pool.positions[s]))
+                if n > 0:
+                    rows.append(n)
+        return (self.pool.bytes_resident(),
+                predict(sspec, rows, n_layers=self._n_layers))
 
     def preempt(self, rid: int) -> None:
         """Evict an ACTIVE request from its slot (priority scheduling's
@@ -457,6 +508,8 @@ class InferenceEngine:
         self.scheduler.queue.appendleft(r)
         if self.metrics is not None:
             self.metrics.on_preempt(r.cls)
+        if self.trace is not None:
+            self.trace.on_preempt(r, self._now)
 
     def cancel(self, rid: int, reason: str = "cancelled") -> Request:
         """Remove a live request NOW with a structured rejection: a queued
@@ -493,8 +546,10 @@ class InferenceEngine:
                     f"queue — lifecycle bookkeeping corrupted")
         r.state = SHED
         r.finish_reason = reason
-        r.done_time = self._clock()
+        r.done_time = self._now = self._clock()
         self._last_emit.pop(rid, None)
+        if self.trace is not None:
+            self.trace.on_shed(r, r.done_time, reason)
         return r
 
     def restore(self, request: Request) -> Request:
@@ -527,6 +582,8 @@ class InferenceEngine:
         self.requests[request.rid] = request
         self._next_rid = max(self._next_rid, request.rid + 1)
         self.scheduler.enqueue(request)
+        if self.trace is not None:
+            self.trace.on_readmit(request, self._now)
         return request
 
     def drain(self, max_ticks: int | None = None) -> list[Request]:
@@ -574,17 +631,26 @@ class InferenceEngine:
                 # giant sample would distort the per-class cadence
                 # histogram the SLO gate reads
                 self.pool.seat(r.slot, t0, r.tokens[-1])
-                self._last_emit[r.rid] = self._clock()
+                now = self._now = self._clock()
+                self._last_emit[r.rid] = now
+                if self.trace is not None:
+                    self.trace.on_admit(r, now, r.slot)
+                    self.trace.on_resume(r, now)
                 continue
             tok = int(np.asarray(tok))           # host sync: TTFT endpoint
             r.key_data = np.asarray(kd)
-            now = self._clock()
+            now = self._now = self._clock()
             r.first_token_time = now
             self._last_emit[r.rid] = now
             r.emit(tok)
             emitted += 1
             if self.metrics is not None:
                 self.metrics.on_first_token(r.ttft_s, cls=r.cls)
+            if self.trace is not None:
+                # dense admission prefills in one shot: boarding and the
+                # TTFT endpoint share this tick's single clock read
+                self.trace.on_admit(r, now, r.slot)
+                self.trace.on_first_token(r, now)
             reason = r.finished_by(tok)
             if reason is not None:
                 self._finish(r, reason, now)
@@ -615,6 +681,10 @@ class InferenceEngine:
         :meth:`_prefill_tick`."""
         for r in self.scheduler.admit():
             self._prefilling.append(r.rid)
+            if self.trace is not None:
+                # boarding performs no clock read; stamped with the most
+                # recent one (at most a tick stale, see serve/tracing.py)
+                self.trace.on_admit(r, self._now, r.slot)
 
     def _prefill_tick(self) -> int:
         """At most ONE prefill chunk per tick — the scheduler's budget that
@@ -630,7 +700,7 @@ class InferenceEngine:
         p0 = r.prefill_pos
         c = (plen - p0 if self.prefill_chunk is None
              else min(self.prefill_chunk, plen - p0))
-        t_start = self._clock()
+        t_start = self._now = self._clock()
         self._ensure_writable_range(r.slot, p0, c)
         kc, vc, tok, kd = self._chunk_prefill(
             self.params, self.pool.kc, self.pool.vc,
@@ -641,9 +711,11 @@ class InferenceEngine:
             np.float32(r.top_p if r.top_p is not None else _NO_TOP_P))
         self.pool.kc, self.pool.vc = kc, vc
         tok = int(np.asarray(tok))     # host sync: honest chunk timing
-        now = self._clock()
+        now = self._now = self._clock()
         if self.metrics is not None:
             self.metrics.on_prefill_chunk((now - t_start) * 1e3)
+        if self.trace is not None:
+            self.trace.on_prefill_chunk(r, t_start, now, p0, c)
         if p0 + c < plen:
             # mid-prompt chunk: the sampled token AND returned key are
             # discarded — the request's key stream advances exactly once,
@@ -671,6 +743,8 @@ class InferenceEngine:
             # preemption wait is not decode cadence
             self.pool.seat(r.slot, plen, r.tokens[-1])
             self._last_emit[r.rid] = now
+            if self.trace is not None:
+                self.trace.on_resume(r, now)
             return 0
         r.key_data = np.asarray(kd)
         r.first_token_time = now
@@ -678,6 +752,8 @@ class InferenceEngine:
         r.emit(tok)
         if self.metrics is not None:
             self.metrics.on_first_token(r.ttft_s, cls=r.cls)
+        if self.trace is not None:
+            self.trace.on_first_token(r, now)
         reason = r.finished_by(tok)
         if reason is not None:
             self._finish(r, reason, now)
@@ -813,7 +889,7 @@ class InferenceEngine:
         nacc = np.asarray(nacc)
         kd2 = np.asarray(kd2)
         dkd2 = np.asarray(dkd2)
-        now = self._clock()
+        now = self._now = self._clock()
         emitted = proposed = accepted = 0
         for s in active:
             r = self.requests[self.pool.occupant(s)]
@@ -836,8 +912,14 @@ class InferenceEngine:
                     self.metrics.on_token(dt / n_emit, cls=r.cls)
             self._last_emit[r.rid] = now
             emitted += n_emit
-            proposed += max(int(valid[s]) - 1, 0)
-            accepted += max(n_emit - 1, 0)
+            slot_proposed = max(int(valid[s]) - 1, 0)
+            slot_accepted = max(n_emit - 1, 0)
+            proposed += slot_proposed
+            accepted += slot_accepted
+            if self.trace is not None:
+                self.trace.on_tick_tokens(r, now, n_emit,
+                                          proposed=slot_proposed,
+                                          accepted=slot_accepted)
             if finish is not None:
                 self._finish(r, finish, now)
             else:
@@ -866,7 +948,7 @@ class InferenceEngine:
     def _emit_decoded(self, active: list[int], toks, kd2) -> int:
         toks = np.asarray(toks)                  # host sync: tick endpoint
         kd2 = np.asarray(kd2)
-        now = self._clock()
+        now = self._now = self._clock()
         emitted = 0
         for s in active:
             r = self.requests[self.pool.occupant(s)]
@@ -877,6 +959,8 @@ class InferenceEngine:
             if self.metrics is not None:
                 self.metrics.on_token(now - self._last_emit[r.rid],
                                       cls=r.cls)
+            if self.trace is not None:
+                self.trace.on_tick_tokens(r, now, 1)
             self._last_emit[r.rid] = now
             reason = r.finished_by(tok)
             if reason is not None:
@@ -888,6 +972,8 @@ class InferenceEngine:
     def _finish(self, r: Request, reason: str, now: float) -> None:
         r.done_time = now
         self._last_emit.pop(r.rid, None)
+        if self.trace is not None:
+            self.trace.on_finish(r, now, reason)
         if r.state == ACTIVE:
             # scheduler.retire unbinds the sequence (paged: decref table
             # blocks — registered ones stay reclaimable — and return the

@@ -175,17 +175,30 @@ class RequestJournal:
             os.fsync(self._f.fileno())
         self.bytes += len(line)
 
+    @staticmethod
+    def _tick_field(tick) -> dict:
+        """The monotonic ``tick`` rider (the supervisor's restart-surviving
+        counter): present when the writer supplies one, absent otherwise —
+        which is also the backward-compat story: :func:`recover_state`
+        never reads it, so journals written before the field existed (and
+        writers that never pass it) stay cold-restartable unchanged. Its
+        purpose is the FORENSIC join — post-mortem bundle flight-recorder
+        rows carry the same tick, so journal lines and engine snapshots
+        line up exactly."""
+        return {} if tick is None else {"tick": int(tick)}
+
     def log_submit(self, *, rid: int, prompt, max_new: int, temp: float,
                    top_k, top_p, eos, seed: int, cls, prio: int,
-                   ttft_dl, dl, t) -> None:
+                   ttft_dl, dl, t, tick=None) -> None:
         self.append({"ev": "submit", "rid": rid,
                      "prompt": [int(x) for x in np.asarray(prompt)],
                      "max_new": int(max_new), "temp": float(temp),
                      "top_k": top_k, "top_p": top_p, "eos": eos,
                      "seed": int(seed), "cls": cls, "prio": int(prio),
-                     "ttft_dl": ttft_dl, "dl": dl, "t": t})
+                     "ttft_dl": ttft_dl, "dl": dl, "t": t,
+                     **self._tick_field(tick)})
 
-    def log_token(self, request: Request, token: int) -> None:
+    def log_token(self, request: Request, token: int, tick=None) -> None:
         """One emitted token WITH the request's post-emit key state (the
         engine updates ``key_data`` before ``emit`` fires the callback, so
         at call time the fields are exactly what the continuation needs).
@@ -209,17 +222,30 @@ class RequestJournal:
             "dkd": None if dkd is None else [int(x) for x in
                                              np.asarray(dkd)],
             **({"t": request.first_token_time}
-               if len(request.tokens) == 1 else {})})
+               if len(request.tokens) == 1 else {}),
+            **self._tick_field(tick)})
 
-    def log_done(self, *, rid: int, reason: str, t=None) -> None:
-        self.append({"ev": "done", "rid": rid, "reason": reason, "t": t})
+    def log_done(self, *, rid: int, reason: str, t=None, tick=None) -> None:
+        self.append({"ev": "done", "rid": rid, "reason": reason, "t": t,
+                     **self._tick_field(tick)})
 
-    def log_shed(self, *, rid: int, reason: str, t=None) -> None:
-        self.append({"ev": "shed", "rid": rid, "reason": reason, "t": t})
+    def log_shed(self, *, rid: int, reason: str, t=None, tick=None) -> None:
+        self.append({"ev": "shed", "rid": rid, "reason": reason, "t": t,
+                     **self._tick_field(tick)})
 
-    def log_restart(self, n: int, degraded: bool, cause: str) -> None:
+    def log_restart(self, n: int, degraded: bool, cause: str,
+                    tick=None) -> None:
         self.append({"ev": "restart", "n": int(n),
-                     "degraded": bool(degraded), "cause": cause})
+                     "degraded": bool(degraded), "cause": cause,
+                     **self._tick_field(tick)})
+
+    def tail(self, n: int = 64) -> list[dict]:
+        """The last ``n`` valid journal events, re-read from disk — the
+        post-mortem bundle's journal block (bundles are rare; the re-read
+        keeps this as honest as :meth:`recovered_state`)."""
+        self._f.flush()
+        events, _ = read_journal(self.path)
+        return events[-n:]
 
     def close(self) -> None:
         if not self._f.closed:

@@ -12,7 +12,8 @@ The serving-standard latency split, as registry instruments:
   into aggregate throughput.
 - ``serve_requests_submitted_total`` / ``serve_requests_completed_total`` /
   ``serve_tokens_generated_total`` (counters) and ``serve_tokens_per_sec``
-  (gauge over the wall-clock window from first submit to last token).
+  (gauge) — lifetime request/token counters and aggregate throughput over
+  the wall-clock window from first submit to last token.
 
 Paged-pool instruments (populated only by ``kv_layout="paged"`` engines —
 the engine hands the pool's stats to :meth:`ServeMetrics.on_tick`):
@@ -63,6 +64,17 @@ Crash-restart + overload-control instruments (fed by the serve supervisor,
   degraded mode (fallback engine layout after repeated crashes, or the
   overload best-effort lockout);
 - ``serve_journal_bytes`` (gauge) — the request journal's durable size.
+
+Model-drift instruments (ISSUE 12 — the PR-8 static model checked as a
+runtime invariant, fed every tick from ``engine.kv_drift``):
+
+- ``serve_kv_bytes_predicted`` (gauge) — the analyzer's
+  ``predict_kv_bytes_resident`` over the live sequences' written-row
+  counts: what the static HBM model says the pool must be pinning;
+- ``serve_kv_drift_bytes`` (gauge) — live resident bytes minus the
+  prediction: exactly 0 without prefix sharing, ≤ 0 with it (sharing only
+  shrinks the truth), > 0 only on a block-accounting leak — the invariant
+  the clean-run tests pin at zero.
 
 ``emit()`` writes one ``kind: "serve"`` record to ``metrics.jsonl`` and
 refreshes ``metrics.prom`` — the same two artifact formats the training
@@ -120,6 +132,10 @@ class ServeMetrics:
         self.blocks_free = r.gauge("serve_blocks_free")
         self.blocks_cached = r.gauge("serve_blocks_cached")
         self.kv_bytes_resident = r.gauge("serve_kv_bytes_resident")
+        # model-drift gauges (both layouts; fed per tick by the engine)
+        self.kv_bytes_predicted = r.gauge("serve_kv_bytes_predicted")
+        self.kv_drift_bytes = r.gauge("serve_kv_drift_bytes")
+        self._drift_seen = False
         self.prefill_chunk_ms = r.histogram("serve_prefill_chunk_ms")
         self._pool_counters = {k: r.counter(v)
                                for k, v in _POOL_COUNTERS.items()}
@@ -249,16 +265,23 @@ class ServeMetrics:
     def on_tick(self, queue_depth: int, active: int, total: int,
                 decode_active: int | None = None,
                 block_stats: dict | None = None,
-                tp: int | None = None, spec_k: int | None = None) -> None:
+                tp: int | None = None, spec_k: int | None = None,
+                kv_predicted: int | None = None,
+                kv_drift: int | None = None) -> None:
         """End-of-tick gauges; ``decode_active`` is the occupancy the tick's
         batched decode ran at (sampled BEFORE same-tick retirement — the
         number batching converts into throughput). Ticks that ran no decode
         (``decode_active == 0``) skip the occupancy observation.
         ``block_stats`` is ``PagedKVPool.stats()`` — lifetime counters are
-        converted to registry increments here."""
+        converted to registry increments here. ``kv_predicted``/``kv_drift``
+        are the engine's per-tick model check (``engine.kv_drift``)."""
         self.queue_depth.set(queue_depth)
         self.slots_active.set(active)
         self.slots_total.set(total)
+        if kv_predicted is not None:
+            self._drift_seen = True
+            self.kv_bytes_predicted.set(kv_predicted)
+            self.kv_drift_bytes.set(kv_drift or 0)
         if tp is not None:
             self._shape_seen = True
             self.tp_gauge.set(tp)
@@ -368,6 +391,9 @@ class ServeMetrics:
                 "degraded": int(self.degraded_gauge.value),
                 "journal_bytes": int(self.journal_bytes_gauge.value),
             })
+        if self._drift_seen:
+            out["kv_bytes_predicted"] = int(self.kv_bytes_predicted.value)
+            out["kv_drift_bytes"] = int(self.kv_drift_bytes.value)
         if self._classes:
             out["per_class"] = {cls: self.class_summary(cls)
                                 for cls in sorted(self._classes)}

@@ -210,6 +210,18 @@ class KVCachePool(_SlotPoolBase):
         cd = _cache_dtype(cache_dtype)
         self.kc = jnp.zeros(shape, cd)
         self.vc = jnp.zeros(shape, cd)
+        # PER-SHARD bytes, like the paged pool's bytes_per_block: one row
+        # is a max_len-sized "block", and every row is pinned up front —
+        # occupancy never changes what a dense pool holds resident
+        self._bytes_total = kv_block_bytes(n_layers, n_heads // self.tp,
+                                           max_len, head_dim, cd) * n_slots
+
+    def bytes_resident(self) -> int:
+        """The dense pool's resident K/V bytes: the full allocation,
+        regardless of occupancy (the paged layout exists to shrink exactly
+        this). The KV-drift gauge checks it against the analyzer's dense
+        prediction — equality is a geometry/bookkeeping invariant."""
+        return self._bytes_total
 
     def can_admit(self, request) -> bool:
         """Dense admission gate: one free slot IS the whole budget (the row

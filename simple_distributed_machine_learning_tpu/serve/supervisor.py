@@ -58,6 +58,7 @@ unconditionally bit-exact.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 import numpy as np
@@ -188,13 +189,17 @@ class ServeSupervisor:
                  degrade_after: int | None = None,
                  overload: OverloadPolicy | None = None,
                  default_ttft_deadline_s: float | None = None,
-                 default_deadline_s: float | None = None) -> None:
+                 default_deadline_s: float | None = None,
+                 trace=None, flight=None, postmortem_dir: str | None = None,
+                 postmortem_tail: int = 64, shed_burst: int = 4) -> None:
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, got "
                              f"{max_restarts}")
         if degrade_after is not None and degrade_after < 1:
             raise ValueError(f"degrade_after must be >= 1 restarts, got "
                              f"{degrade_after}")
+        if shed_burst < 1:
+            raise ValueError(f"shed_burst must be >= 1, got {shed_burst}")
         self.factory = factory
         self.journal = (RequestJournal(journal) if isinstance(journal, str)
                         else journal)
@@ -205,6 +210,28 @@ class ServeSupervisor:
         self.overload = overload if overload is not None else OverloadPolicy()
         self.default_ttft_deadline_s = default_ttft_deadline_s
         self.default_deadline_s = default_deadline_s
+        # observability (ISSUE 12): the request-scoped trace recorder
+        # (re-attached to every rebuilt engine, which is what joins spans
+        # across restarts), the tick flight recorder, and the post-mortem
+        # bundle sink. All off by default; the flight recorder is created
+        # implicitly when bundles are requested (a bundle without flight
+        # rows is a crash report with no flight data).
+        self.trace = trace
+        self.postmortem_dir = postmortem_dir
+        self.postmortem_tail = int(postmortem_tail)
+        self.shed_burst = int(shed_burst)
+        if flight is None and postmortem_dir is not None:
+            from simple_distributed_machine_learning_tpu.serve.flight import (
+                FlightRecorder,
+            )
+            flight = FlightRecorder()
+        self.flight = flight
+        self.postmortems: list[str] = []     # bundle paths, write order
+        self._sheds_since_step = 0
+        #: monotonic tick counter — unlike ``engine._tick_count`` it
+        #: survives engine rebuilds, and it is the ``tick`` every journal
+        #: record and flight-recorder row carries (the forensic join key)
+        self.tick = 0
         self.restarts = 0
         self.degraded = False        # fault-driven: rebuilds use the fallback
         self.load_degraded = False   # overload-driven: best-effort lockout
@@ -214,6 +241,7 @@ class ServeSupervisor:
         self._user_cb: dict[int, object] = {}  # rid -> caller's on_token
         self._buckets: dict[str, tuple[float, float]] = {}
         self.engine = factory(False)
+        self._attach_engine(prev_now=0.0)
         # cold start: a previous process's journal recovers here — its
         # completed streams become readable handles, its in-flight requests
         # re-admit and continue bit-exact (no restart consumed: the budget
@@ -221,6 +249,16 @@ class ServeSupervisor:
         snapshots = self.journal.recovered_state()
         if snapshots:
             self._reseat(snapshots, note_recovered=True)
+
+    def _attach_engine(self, prev_now: float) -> None:
+        """Wire the (re)built engine into the shared observability state:
+        the trace recorder outlives engines — that is what joins a
+        request's spans across incarnations — and the new engine's
+        last-read-clock seed carries over so post-crash trace stamps stay
+        monotonic (never a fresh clock read)."""
+        if self.trace is not None:
+            self.engine.trace = self.trace
+        self.engine._now = max(self.engine._now, prev_now)
 
     # -- the engine surface -------------------------------------------------
 
@@ -274,7 +312,7 @@ class ServeSupervisor:
             rid=rid, prompt=prompt, max_new=max_new_tokens,
             temp=temperature, top_k=top_k, top_p=top_p, eos=eos_id,
             seed=seed, cls=cls, prio=priority, ttft_dl=ttft_deadline_s,
-            dl=deadline_s, t=now)
+            dl=deadline_s, t=now, tick=self.tick)
         try:
             r = self.engine.submit(
                 prompt, max_new_tokens, temperature=temperature,
@@ -294,7 +332,11 @@ class ServeSupervisor:
 
     def step(self) -> int:
         """One supervised tick: deadline shedding, then the engine tick
-        (recoverable failures recover in place), then completion acks."""
+        (recoverable failures recover in place), then completion acks.
+        Each call advances the MONOTONIC :attr:`tick` (journal records and
+        flight-recorder rows both carry it), records one flight snapshot,
+        and dumps a post-mortem bundle when this tick shed a burst."""
+        self.tick += 1
         self._shed_expired()
         try:
             emitted = self.engine.step()
@@ -306,6 +348,15 @@ class ServeSupervisor:
         #                                even if no further arrival probes it
         if self.metrics is not None:
             self.metrics.set_journal_bytes(self.journal.bytes)
+        if self.flight is not None:
+            self.flight.snap(self.engine, self.tick, emitted,
+                             state=self.state, restarts=self.restarts,
+                             degraded=self.degraded,
+                             load_degraded=self.load_degraded)
+        if self._sheds_since_step >= self.shed_burst:
+            self._dump_postmortem(
+                "shed_burst", f"{self._sheds_since_step} sheds in one tick")
+        self._sheds_since_step = 0
         return emitted
 
     def drain(self, max_ticks: int | None = None) -> list[Request]:
@@ -315,15 +366,50 @@ class ServeSupervisor:
         ticks = 0
         while self.busy:
             if max_ticks is not None and ticks >= max_ticks:
-                raise DrainTimeout(max_ticks, [
+                exc = DrainTimeout(max_ticks, [
                     r for r in self.requests.values()
                     if r.state in (QUEUED, ACTIVE)])
+                # the wedged-drain forensics: what was still queued/active,
+                # what the last N ticks looked like, what the journal last
+                # saw — dumped BEFORE the raise so the bundle exists even
+                # when the caller dies on the exception
+                self._dump_postmortem("drain_timeout", str(exc))
+                raise exc
             self.step()
             ticks += 1
         return [r for r in self.requests.values() if r.state == DONE]
 
     def close(self) -> None:
         self.journal.close()
+        if self.trace is not None:
+            self.trace.flush()
+
+    # -- post-mortem bundles ------------------------------------------------
+
+    def _dump_postmortem(self, trigger: str, cause: str) -> str | None:
+        """Write one post-mortem bundle (``serve/flight.py::write_bundle``)
+        into ``postmortem_dir``: last-N flight rows + every request's state
+        + a metrics snapshot + the journal tail, joined on rid and the
+        monotonic tick. No-op without a configured directory."""
+        if self.postmortem_dir is None:
+            return None
+        from simple_distributed_machine_learning_tpu.serve.flight import (
+            BUNDLE_PREFIX,
+            write_bundle,
+        )
+        path = os.path.join(
+            self.postmortem_dir,
+            f"{BUNDLE_PREFIX}-{len(self.postmortems):03d}-{trigger}.json")
+        write_bundle(
+            path, trigger=trigger, cause=cause, tick=self.tick,
+            flight=self.flight, requests=self.requests,
+            registry=(self.metrics.registry
+                      if self.metrics is not None else None),
+            journal_tail=self.journal.tail(self.postmortem_tail),
+            restarts=self.restarts, degraded=self.degraded,
+            state=self.state)
+        self.postmortems.append(path)
+        return path
 
     # -- overload control ---------------------------------------------------
 
@@ -420,10 +506,12 @@ class ServeSupervisor:
                 self._shed_live(r, "deadline")
 
     def _shed_live(self, r: Request, reason: str) -> None:
-        self.engine.cancel(r.rid, reason)
-        self.journal.log_shed(rid=r.rid, reason=reason, t=r.done_time)
+        self.engine.cancel(r.rid, reason)     # emits the trace shed event
+        self.journal.log_shed(rid=r.rid, reason=reason, t=r.done_time,
+                              tick=self.tick)
         self._open.discard(r.rid)
         self._user_cb.pop(r.rid, None)
+        self._sheds_since_step += 1
         if self.metrics is not None:
             self.metrics.on_shed(reason, cls=r.cls)
 
@@ -447,12 +535,18 @@ class ServeSupervisor:
         self.journal.log_submit(
             rid=rid, prompt=prompt, max_new=max_new, temp=temperature,
             top_k=top_k, top_p=top_p, eos=eos_id, seed=seed, cls=cls,
-            prio=priority, ttft_dl=ttft_dl, dl=dl, t=now)
-        self.journal.log_shed(rid=rid, reason=reason, t=now)
+            prio=priority, ttft_dl=ttft_dl, dl=dl, t=now, tick=self.tick)
+        self.journal.log_shed(rid=rid, reason=reason, t=now, tick=self.tick)
         self.requests[rid] = r
+        self._sheds_since_step += 1
         if self.metrics is not None:
             self.metrics.on_submit()
             self.metrics.on_shed(reason, cls=cls)
+        if self.trace is not None:
+            # the engine never saw this request: open AND close its span
+            # here so the timeline still accounts for the rejection
+            self.trace.on_submit(r, now)
+            self.trace.on_shed(r, now, reason)
         return r
 
     # -- crash recovery -----------------------------------------------------
@@ -461,7 +555,7 @@ class ServeSupervisor:
         """Every engine token flows through here: journal first (the
         durability point), then the caller's callback — 'journaled but not
         acked' is the recoverable order, the reverse would lose tokens."""
-        self.journal.log_token(request, token)
+        self.journal.log_token(request, token, tick=self.tick)
         cb = self._user_cb.get(request.rid)
         if cb is not None:
             cb(request, token)
@@ -471,7 +565,7 @@ class ServeSupervisor:
             r = self.requests[rid]
             if r.state == DONE:
                 self.journal.log_done(rid=rid, reason=r.finish_reason,
-                                      t=r.done_time)
+                                      t=r.done_time, tick=self.tick)
                 self._open.discard(rid)
                 self._user_cb.pop(rid, None)
 
@@ -491,8 +585,14 @@ class ServeSupervisor:
         )
         self.state = RECOVERING
         self.restarts += 1
+        # the dead engine's last clock reading: every crash-boundary trace
+        # stamp (and the rebuilt engine's seed) uses it — recovery must
+        # not read the clock, or virtual-clock pins would move
+        prev_now = self.engine._now
         if self.restarts > self.max_restarts:
             self.state = FAILED
+            self._dump_postmortem("restart_budget",
+                                  f"{type(exc).__name__}: {exc}")
             raise RestartBudgetExceeded(
                 f"{self.restarts} engine failures exceed the max_restarts="
                 f"{self.max_restarts} budget; last: "
@@ -503,11 +603,26 @@ class ServeSupervisor:
         if self.metrics is not None:
             self.metrics.on_restart()
         self.journal.log_restart(self.restarts, self.degraded,
-                                 type(exc).__name__)
+                                 type(exc).__name__, tick=self.tick)
+        if self.trace is not None:
+            self.trace.on_crash(
+                prev_now,
+                [rid for rid in self._open
+                 if self.requests[rid].state in (QUEUED, ACTIVE)],
+                type(exc).__name__)
+        # the moment-of-failure forensics, BEFORE anything is rebuilt:
+        # the dead incarnation's flight rows, its request states, the
+        # journal tail — what a post-mortem actually reads
+        self._dump_postmortem("restart",
+                              f"{type(exc).__name__}: {exc}")
         # journal-ONLY reconstruction: nothing of the dead engine's memory
         # is trusted — exactly the host-kill discipline the trainer has
         snapshots = self.journal.recovered_state()
         self.engine = self.factory(self.degraded)
+        self._attach_engine(prev_now=prev_now)
+        if self.trace is not None:
+            self.trace.on_restart(prev_now, self.restarts, self.degraded,
+                                  type(exc).__name__)
         self._reseat(snapshots, note_recovered=True)
         self.state = RUNNING
         self._note_degraded()    # RUNNING -> DEGRADED when a mode is on
@@ -540,7 +655,7 @@ class ServeSupervisor:
                 # is already complete and identical — ack it now
                 if r.state == DONE:
                     self.journal.log_done(rid=rid, reason=r.finish_reason,
-                                          t=r.done_time)
+                                          t=r.done_time, tick=self.tick)
                 self._open.discard(rid)
                 self._user_cb.pop(rid, None)
         for r in inflight:

@@ -16,9 +16,23 @@ harness thread through. Module map:
   ``memory_stats()`` sampling;
 - ``ici.py`` — static expected collective bytes/step, read-only reuse of
   ``analysis``'s bytes-over-ICI cost table;
-- ``bubble.py`` — the GPipe / 1F1B pipeline-bubble schedule model;
+- ``bubble.py`` — the GPipe / 1F1B pipeline-bubble schedule model, plus
+  measured-vs-modeled drift helpers (``measured_bubble_fraction``,
+  ``bubble_drift``);
 - ``session.py`` — :class:`Telemetry`, the orchestrator (``metrics.jsonl``,
-  ``trace.json``, ``metrics.prom`` under one directory).
+  ``trace.json``, ``metrics.prom`` under one directory);
+- ``catalog.py`` — the docstring-sourced metric-help catalog behind the
+  Prometheus exposition's ``# HELP`` lines (source-parsed via ``ast``, no
+  heavy imports);
+- ``report.py`` — the stdlib-only run-report CLI: ``python -m
+  simple_distributed_machine_learning_tpu.telemetry.report --dir DIR``
+  renders per-class attainment, shed breakdown, restart timeline,
+  latency quantiles, drift gauges and post-mortem bundles from a
+  telemetry directory.
+
+The serving twin lives in ``serve/tracing.py`` (request-scoped async span
+timelines on this module's :class:`Tracer` async-event support) and
+``serve/flight.py`` (tick flight recorder + post-mortem bundles).
 
 Entry points: ``Trainer(..., telemetry=Telemetry(dir))``, ``cli.py
 --telemetry-dir DIR [--telemetry-every N]``, and ``bench.py`` rows (step-time

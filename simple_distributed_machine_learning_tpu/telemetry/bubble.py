@@ -54,3 +54,34 @@ def ideal_step_time(measured_step_s: float, n_stages: int,
     """
     frac = schedule_bubble_fraction(n_stages, n_microbatches, schedule)
     return measured_step_s * (1.0 - frac)
+
+
+def measured_bubble_fraction(measured_step_s: float,
+                             ideal_step_s: float) -> float:
+    """The MEASURED bubble: idle share implied by a real step time against
+    a bubble-free reference — ``1 - ideal / measured``, clamped to
+    ``[0, 1]``.
+
+    ``ideal_step_s`` is a bubble-free calibration of the same work: a
+    single-stage (fused) run of the identical model and microbatch count,
+    or an analytic estimate. Unlike :func:`ideal_step_time` (which
+    *assumes* the schedule model to back the ideal out of one
+    measurement), this takes the reference as an independent input — so
+    comparing the result to :func:`schedule_bubble_fraction` is a real
+    check, not a tautology."""
+    if measured_step_s <= 0 or ideal_step_s <= 0:
+        raise ValueError(
+            f"step times must be > 0, got measured={measured_step_s}, "
+            f"ideal={ideal_step_s}")
+    return min(1.0, max(0.0, 1.0 - ideal_step_s / measured_step_s))
+
+
+def bubble_drift(n_stages: int, n_microbatches: int, schedule: str,
+                 measured_step_s: float, ideal_step_s: float) -> float:
+    """Measured minus modeled bubble fraction — the pipeline twin of the
+    serving KV-drift gauge: ~0 when the uniform-slot schedule model holds,
+    positive when real stages idle longer than ``(S-1)/(M+S-1)`` predicts
+    (imbalanced stages, comm on the critical path), negative when overlap
+    hides more than the model credits."""
+    return (measured_bubble_fraction(measured_step_s, ideal_step_s)
+            - schedule_bubble_fraction(n_stages, n_microbatches, schedule))

@@ -201,12 +201,27 @@ class MetricsRegistry:
         return out
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition (histograms as summary quantiles)."""
+        """Prometheus text exposition (histograms as summary quantiles).
+
+        Each name's first series is preceded by a ``# HELP`` line when the
+        metric-catalog (``telemetry/catalog.py`` — sourced from the module
+        docstrings that document the instruments) knows the name, then the
+        ``# TYPE`` line. Label VALUES are escaped per the exposition format
+        (backslash, double quote, newline) — a class label containing ``"``
+        must scrape, not corrupt the series (tests pin a round-trip
+        parse)."""
+        from simple_distributed_machine_learning_tpu.telemetry.catalog import (
+            metric_help,
+        )
+        help_catalog = metric_help()
         lines = []
         seen_type: set[str] = set()
         for inst in sorted(self._series.values(), key=_series_key):
             if inst.name not in seen_type:
                 seen_type.add(inst.name)
+                doc = help_catalog.get(inst.name)
+                if doc:
+                    lines.append(f"# HELP {inst.name} {_escape_help(doc)}")
                 kind = "summary" if isinstance(inst, Histogram) else inst.kind
                 lines.append(f"# TYPE {inst.name} {kind}")
             if isinstance(inst, Histogram):
@@ -233,11 +248,27 @@ def _series_key(inst) -> str:
     return f"{inst.name}{{{inner}}}"
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition-format label-value escaping: backslash first
+    (or the other escapes would double-escape), then double quote and
+    newline. Without this, a label value containing ``"`` emits a series
+    no scraper can parse."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping (backslash and newline only — quotes are legal
+    in help text), collapsed to one line."""
+    return " ".join(str(text).split()).replace("\\", r"\\")
+
+
 def _labels(labels: dict, **extra) -> str:
     items = {**labels, **extra}
     if not items:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(items.items()))
     return "{" + inner + "}"
 
 

@@ -1,0 +1,252 @@
+"""The run-report CLI: one human summary from a telemetry directory.
+
+``python -m simple_distributed_machine_learning_tpu.telemetry.report
+--dir DIR`` renders everything a run left behind — ``metrics.jsonl``
+(serve / scenario / epoch records), the request journal(s), the
+request-scoped trace timeline(s) and any post-mortem bundles — as one
+summary: per-class SLO attainment, the shed breakdown, the restart
+timeline (journal ``restart`` events with their monotonic ticks), TTFT /
+TPOT quantiles, KV-drift, and the bundle inventory. ``--json`` emits the
+same content as one machine-readable object.
+
+This module is deliberately stdlib-only (``json``/``os``/``glob``/
+``argparse``) — the artifacts are plain JSONL and parsing them is the
+whole job; no device, registry or engine state is touched. (Running it
+via ``python -m`` still executes the package ``__init__``, which imports
+jax — import :func:`collect`/:func:`render` directly for a jax-free
+consumer.) Exit codes: 0 on success, 2 when the directory is missing or
+holds nothing reportable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    """Valid JSON-object lines of ``path`` (torn/corrupt lines skipped —
+    a report renders what survived, it does not police durability)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _fmt(v, nd: int = 3):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{round(v, nd):g}"
+    return str(v)
+
+
+def collect(outdir: str) -> dict:
+    """Gather every artifact in ``outdir`` into one report dict — the
+    single source both renderers (text and ``--json``) consume."""
+    metrics = _read_jsonl(os.path.join(outdir, "metrics.jsonl"))
+    serve = [r for r in metrics if r.get("kind") == "serve"]
+    scenarios = [r for r in metrics if r.get("kind") == "scenario"]
+    epochs = [r for r in metrics if r.get("kind") == "epoch"]
+
+    journals = {}
+    for path in sorted(glob.glob(os.path.join(outdir, "journal*.jsonl"))):
+        events = _read_jsonl(path)
+        counts: dict[str, int] = {}
+        for ev in events:
+            counts[ev.get("ev", "?")] = counts.get(ev.get("ev", "?"), 0) + 1
+        journals[os.path.basename(path)] = {
+            "events": len(events),
+            "by_kind": dict(sorted(counts.items())),
+            "restarts": [
+                {"n": ev.get("n"), "cause": ev.get("cause"),
+                 "degraded": ev.get("degraded"), "tick": ev.get("tick")}
+                for ev in events if ev.get("ev") == "restart"],
+        }
+
+    timelines = {}
+    for path in sorted(glob.glob(
+            os.path.join(outdir, "request_timeline*.jsonl"))):
+        rows = _read_jsonl(path)
+        timelines[os.path.basename(path)] = {
+            "events": len(rows),
+            "requests": len({r.get("rid") for r in rows
+                             if r.get("rid") is not None}),
+            "incarnations": len({r.get("inc", 0) for r in rows}),
+        }
+
+    traces = {}
+    for path in sorted(glob.glob(os.path.join(outdir, "serve_trace*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            traces[os.path.basename(path)] = {
+                "events": len(doc.get("traceEvents", []))}
+        except (OSError, json.JSONDecodeError):
+            traces[os.path.basename(path)] = {"events": None,
+                                              "error": "unparseable"}
+
+    bundles = []
+    for path in sorted(glob.glob(os.path.join(outdir, "postmortem-*.json"))):
+        try:
+            with open(path) as f:
+                b = json.load(f)
+            bundles.append({
+                "file": os.path.basename(path),
+                "trigger": b.get("trigger"), "cause": b.get("cause"),
+                "tick": b.get("tick"), "restarts": b.get("restarts"),
+                "flight_rows": len(b.get("flight", [])),
+                "requests": len(b.get("requests", [])),
+            })
+        except (OSError, json.JSONDecodeError):
+            bundles.append({"file": os.path.basename(path),
+                            "error": "unparseable"})
+
+    return {
+        "dir": outdir,
+        "serve": serve[-1] if serve else None,
+        "scenarios": scenarios,
+        "epochs": len(epochs),
+        "last_epoch": epochs[-1] if epochs else None,
+        "journals": journals,
+        "timelines": timelines,
+        "traces": traces,
+        "postmortems": bundles,
+    }
+
+
+def render(report: dict) -> str:
+    """The human rendering of :func:`collect`'s output."""
+    lines = [f"run report: {report['dir']}"]
+    s = report["serve"]
+    if s:
+        lines.append(
+            f"  serve: {s.get('requests_submitted', 0)} submitted, "
+            f"{s.get('requests_completed', 0)} completed, "
+            f"{s.get('tokens_generated', 0)} tokens "
+            f"({_fmt(s.get('tokens_per_sec'))} tok/s)")
+        lines.append(
+            f"  latency: ttft p50/p95 {_fmt(s.get('ttft_ms_p50'))}/"
+            f"{_fmt(s.get('ttft_ms_p95'))} ms, tpot p50/p95 "
+            f"{_fmt(s.get('tpot_ms_p50'))}/{_fmt(s.get('tpot_ms_p95'))} ms, "
+            f"occupancy {_fmt(s.get('slot_occupancy_mean'))}")
+        if "restarts" in s:
+            lines.append(
+                f"  resilience: {s['restarts']} restart(s), "
+                f"{s.get('recovered_requests', 0)} recovered, "
+                f"{s.get('shed_total', 0)} shed {s.get('shed_by_reason', {})}"
+                f", degraded={s.get('degraded', 0)}")
+        if "kv_drift_bytes" in s:
+            ok = "OK" if s["kv_drift_bytes"] == 0 else "NONZERO"
+            lines.append(
+                f"  kv drift: live-vs-model {s['kv_drift_bytes']} bytes "
+                f"[{ok}] (predicted {s.get('kv_bytes_predicted')}, "
+                f"resident {s.get('kv_bytes_resident', 'n/a')})")
+        for cls, blk in sorted((s.get("per_class") or {}).items()):
+            lines.append(
+                f"  class {cls}: {blk.get('completed', 0)} completed, "
+                f"{blk.get('shed', 0)} shed, ttft p95 "
+                f"{_fmt(blk.get('ttft_ms_p95'))} ms, tpot p95 "
+                f"{_fmt(blk.get('tpot_ms_p95'))} ms")
+    for scen in report["scenarios"]:
+        verdict = "PASS" if scen.get("slo_ok") else "FAIL"
+        lines.append(
+            f"  scenario {scen.get('scenario')} [{verdict}]: "
+            f"{scen.get('completed')}/{scen.get('n_requests')} completed, "
+            f"{scen.get('shed', 0)} shed"
+            + (f", {scen['restarts']} restart(s)"
+               if "restarts" in scen else ""))
+        for cls, att in sorted((scen.get("slo") or {}).items()):
+            gates = [f"{k.split('_')[0]} {_fmt(att[k])}"
+                     for k in ("ttft_attainment", "tpot_attainment")
+                     if k in att]
+            lines.append(f"    {cls}: attainment {', '.join(gates)} "
+                         f"[{'ok' if att.get('ok') else 'MISS'}]")
+    for name, j in report["journals"].items():
+        lines.append(f"  journal {name}: {j['events']} events "
+                     f"{j['by_kind']}")
+        for r in j["restarts"]:
+            lines.append(
+                f"    restart #{r['n']} @tick {_fmt(r['tick'])} "
+                f"cause {r['cause']} degraded={r['degraded']}")
+    for name, t in report["timelines"].items():
+        lines.append(f"  timeline {name}: {t['events']} events over "
+                     f"{t['requests']} request(s), "
+                     f"{t['incarnations']} incarnation(s)")
+    for name, t in report["traces"].items():
+        lines.append(f"  trace {name}: {_fmt(t.get('events'))} Chrome "
+                     f"events" + (" [UNPARSEABLE]" if t.get("error")
+                                  else ""))
+    for b in report["postmortems"]:
+        if b.get("error"):
+            lines.append(f"  postmortem {b['file']}: UNPARSEABLE")
+        else:
+            lines.append(
+                f"  postmortem {b['file']}: {b['trigger']} @tick "
+                f"{_fmt(b['tick'])} ({b['cause']}), "
+                f"{b['flight_rows']} flight rows, "
+                f"{b['requests']} request states")
+    if report["epochs"]:
+        le = report["last_epoch"]
+        lines.append(
+            f"  training: {report['epochs']} epoch record(s), last: "
+            f"step p50 {_fmt(le.get('step_time_ms_p50'))} ms"
+            + (f", bubble model {_fmt(le.get('bubble_fraction'))}"
+               if le.get("bubble_fraction") is not None else "")
+            + (f" measured {_fmt(le.get('bubble_fraction_measured'))}"
+               f" drift {_fmt(le.get('bubble_drift'))}"
+               if le.get("bubble_drift") is not None else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m simple_distributed_machine_learning_tpu."
+             "telemetry.report",
+        description="Render one run summary from a telemetry directory "
+                    "(metrics.jsonl + journal + trace + post-mortems).")
+    ap.add_argument("--dir", required=True,
+                    help="the run's --telemetry-dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object instead of "
+                         "the human rendering")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        print(f"report: no such directory: {args.dir}", file=sys.stderr)
+        return 2
+    report = collect(args.dir)
+    # "reportable" = ANY artifact family present — a crash can die before
+    # metrics.jsonl exists while the trace/timeline/bundles (exactly the
+    # forensic case) are already on disk
+    if (report["serve"] is None and not report["scenarios"]
+            and not report["epochs"] and not report["journals"]
+            and not report["timelines"] and not report["traces"]
+            and not report["postmortems"]):
+        print(f"report: nothing reportable under {args.dir} "
+              f"(no metrics.jsonl records, journals or traces)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":                      # pragma: no cover - CLI
+    sys.exit(main())

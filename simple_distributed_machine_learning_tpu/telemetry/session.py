@@ -60,6 +60,7 @@ class Telemetry:
         self._probe = None          # (fn, args, kwargs, mesh, steps) thunk args
         self._ici_info = None
         self._ici_done = False
+        self._ideal_step_s = None   # bubble-free reference (set_bubble_reference)
         if self._is_main():
             os.makedirs(outdir, exist_ok=True)
 
@@ -115,6 +116,21 @@ class Telemetry:
         self._win_examples = 0.0
         self._win_tokens = 0.0
 
+    # -- bubble drift (measured vs modeled pipeline idle) ------------------
+
+    def set_bubble_reference(self, ideal_step_s: float) -> None:
+        """Register a bubble-free step-time reference (a fused/1-stage run
+        of the same work, or an analytic estimate). With it, every epoch
+        record gains ``bubble_fraction_measured`` and ``bubble_drift``
+        (measured − modeled — the schedule model checked against reality,
+        the training twin of serving's ``serve_kv_drift_bytes``). Without
+        a reference the drift is simply not emitted — never fabricated
+        from the model itself, which would be a tautology."""
+        if ideal_step_s <= 0:
+            raise ValueError(
+                f"ideal_step_s must be > 0, got {ideal_step_s}")
+        self._ideal_step_s = float(ideal_step_s)
+
     # -- static step probe (ICI bytes) ------------------------------------
 
     def set_step_probe(self, fn, *abstract_args, mesh=None,
@@ -158,6 +174,17 @@ class Telemetry:
             rec["n_microbatches"] = pipe.n_microbatches
             rec["bubble_fraction"] = round(frac, 4)
             self.registry.gauge("bubble_fraction").set(frac)
+            p50 = rec.get("step_time_ms_p50")
+            if self._ideal_step_s is not None and p50:
+                from simple_distributed_machine_learning_tpu.telemetry.bubble import (  # noqa: E501
+                    measured_bubble_fraction,
+                )
+                measured = measured_bubble_fraction(p50 / 1e3,
+                                                    self._ideal_step_s)
+                rec["bubble_fraction_measured"] = round(measured, 4)
+                rec["bubble_drift"] = round(measured - frac, 4)
+                self.registry.gauge("bubble_fraction_measured").set(measured)
+                self.registry.gauge("bubble_drift").set(measured - frac)
         info = self._ici_bytes()
         if info is not None:
             rec["ici_bytes_per_step"] = info["ici_bytes_per_step"]

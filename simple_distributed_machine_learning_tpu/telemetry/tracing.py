@@ -24,13 +24,32 @@ from simple_distributed_machine_learning_tpu.utils import profiler
 
 
 class Tracer:
-    """Collects completed spans; thread-safe; ``write`` emits Chrome JSON."""
+    """Collects completed spans; thread-safe; ``write`` emits Chrome JSON.
 
-    def __init__(self, process_name: str = "sdml") -> None:
+    Two event families:
+
+    - :meth:`span` / :meth:`instant` — synchronous host intervals on the
+      calling thread's track (``ph: "X"``/``"i"``), stamped from this
+      process's wall clock;
+    - :meth:`async_begin` / :meth:`async_end` / :meth:`async_instant` —
+      Chrome *async* events (``ph: "b"``/``"e"``/``"n"``) keyed by an
+      explicit ``(cat, id)`` pair, so arbitrarily overlapping timelines
+      (e.g. concurrent serving requests) render as separate tracks instead
+      of nesting wrongly by ts containment. Async events accept an explicit
+      ``ts_us`` so a caller with its own clock (the serve engine's —
+      possibly a :class:`~..resilience.scenarios.VirtualClock`) can stamp
+      events without this tracer ever reading a clock itself.
+
+    ``pid`` overrides the recorded process id (``ServeTrace`` pins it to 0
+    so virtual-clock traces are byte-identical across runs and machines).
+    """
+
+    def __init__(self, process_name: str = "sdml",
+                 pid: int | None = None) -> None:
         self._t0_ns = time.perf_counter_ns()
         self._events: list[dict] = []
         self._lock = threading.Lock()
-        self._pid = os.getpid()
+        self._pid = os.getpid() if pid is None else int(pid)
         self._process_name = process_name
 
     def _now_us(self) -> float:
@@ -65,6 +84,36 @@ class Tracer:
             ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
         with self._lock:
             self._events.append(ev)
+
+    # -- async (overlapping) spans ----------------------------------------
+
+    def _async_event(self, ph: str, name: str, aid, ts_us, cat: str,
+                     attrs: dict) -> None:
+        ev = {"name": name, "ph": ph, "cat": cat, "id": str(aid),
+              "ts": self._now_us() if ts_us is None else float(ts_us),
+              "pid": self._pid, "tid": 0}
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            self._events.append(ev)
+
+    def async_begin(self, name: str, aid, ts_us: float | None = None,
+                    cat: str = "async", **attrs) -> None:
+        """Open one async span keyed by ``(cat, aid, name)`` (Chrome ``b``
+        phase). Overlapping spans with distinct ids never nest into each
+        other — the property per-request serve timelines need."""
+        self._async_event("b", name, aid, ts_us, cat, attrs)
+
+    def async_end(self, name: str, aid, ts_us: float | None = None,
+                  cat: str = "async", **attrs) -> None:
+        """Close the matching ``async_begin`` (Chrome ``e`` phase); the
+        viewer pairs strictly on ``(cat, id, name)``, never on nesting."""
+        self._async_event("e", name, aid, ts_us, cat, attrs)
+
+    def async_instant(self, name: str, aid, ts_us: float | None = None,
+                      cat: str = "async", **attrs) -> None:
+        """A zero-duration marker on an async track (Chrome ``n`` phase)."""
+        self._async_event("n", name, aid, ts_us, cat, attrs)
 
     def to_chrome_trace(self) -> dict:
         meta = [{"name": "process_name", "ph": "M", "pid": self._pid,

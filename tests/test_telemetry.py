@@ -4,6 +4,7 @@ hardened profiler.trace, and a Trainer smoke run with a full session."""
 
 import json
 import os
+import re
 import time
 
 import jax
@@ -104,6 +105,53 @@ def test_prometheus_exposition():
     assert "step_time_ms_count 10" in text
 
 
+def test_prometheus_help_lines_from_catalog():
+    """# HELP precedes # TYPE for every cataloged name — the docstring-
+    sourced catalog (telemetry/catalog.py) is the text's one source."""
+    reg = tm.MetricsRegistry()
+    reg.counter("serve_requests_completed_total").inc()
+    reg.gauge("some_uncataloged_metric").set(1)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    i = lines.index("# TYPE serve_requests_completed_total counter")
+    assert lines[i - 1].startswith("# HELP serve_requests_completed_total ")
+    # uncataloged names emit no HELP (never a fabricated one)
+    assert "# HELP some_uncataloged_metric" not in text
+    assert "# TYPE some_uncataloged_metric gauge" in text
+
+
+_PROM_LINE = re.compile(
+    r'^([A-Za-z_][A-Za-z0-9_]*)'
+    r'(?:\{((?:[A-Za-z_][A-Za-z0-9_]*="(?:[^"\\\n]|\\["\\n])*",?)*)\})? '
+    r'(-?[0-9.eE+-]+|NaN)$')
+
+
+def test_prometheus_label_values_escaped_and_parseable():
+    """THE satellite pin: a label value containing quotes, backslashes and
+    newlines must still produce series every line of which matches the
+    exposition grammar — previously `cls='a\"b'` emitted an unscrapeable
+    line."""
+    reg = tm.MetricsRegistry()
+    reg.counter("serve_shed_total",
+                labels={"reason": 'dead"line'}).inc(2)
+    reg.gauge("g", labels={"cls": 'a\\b\nc"d'}).set(1)
+    h = reg.histogram("h", labels={"cls": 'q"'})
+    h.observe(1.0)
+    text = reg.prometheus_text()
+    assert '\\"' in text and "\\n" in text
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"unparseable series line: {line!r}"
+    # the escaped payload round-trips to the original value
+    m = next(line for line in text.splitlines()
+             if line.startswith("serve_shed_total"))
+    inner = m[m.index("{") + 1:m.rindex("}")]
+    val = inner.split("=", 1)[1].strip('"')
+    assert (val.replace(r'\"', '"').replace(r'\n', '\n')
+            .replace('\\\\', '\\') == 'dead"line')
+
+
 def test_append_jsonl_schema_versioned(tmp_path):
     path = str(tmp_path / "m.jsonl")
     out = tm.append_jsonl(path, {"epoch": 1})
@@ -197,6 +245,65 @@ def test_span_closes_on_exception():
     assert "failing" in names               # the failing interval is kept
 
 
+def test_async_events_keyed_by_id_with_explicit_ts():
+    """Chrome b/e async events: interleaved spans under distinct ids stay
+    distinct (no ts-containment nesting), explicit ts_us is honored
+    verbatim (the serve recorder's virtual-clock stamps), and a pinned pid
+    overrides the real one."""
+    tr = tm.Tracer(pid=0)
+    tr.async_begin("request", 1, ts_us=10.0, cat="req", cls="a")
+    tr.async_begin("request", 2, ts_us=15.0, cat="req")    # interleaves
+    tr.async_end("request", 1, ts_us=30.0, cat="req")
+    tr.async_instant("tick", 2, ts_us=31.0, cat="req", tokens=3)
+    tr.async_end("request", 2, ts_us=40.0, cat="req")
+    evs = [e for e in tr.to_chrome_trace()["traceEvents"]
+           if e["ph"] in ("b", "e", "n")]
+    assert [(e["ph"], e["id"], e["ts"]) for e in evs] == [
+        ("b", "1", 10.0), ("b", "2", 15.0), ("e", "1", 30.0),
+        ("n", "2", 31.0), ("e", "2", 40.0)]
+    assert all(e["cat"] == "req" and e["pid"] == 0 for e in evs)
+    assert evs[0]["args"] == {"cls": "a"}
+    assert evs[3]["args"] == {"tokens": 3}
+
+
+def test_tracer_thread_safe_under_concurrent_emission():
+    """The satellite pin: span/instant/async emission from many threads
+    concurrently loses no events and corrupts no structure."""
+    import threading
+
+    tr = tm.Tracer()
+    n_threads, per_thread = 8, 50
+    barrier = threading.Barrier(n_threads)
+
+    def work(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            with tr.span(f"span-{tid}", i=i):
+                pass
+            tr.instant(f"mark-{tid}")
+            tr.async_begin("req", f"{tid}-{i}", ts_us=float(i))
+            tr.async_end("req", f"{tid}-{i}", ts_us=float(i) + 1)
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = tr.to_chrome_trace()["traceEvents"]
+    by_ph = {}
+    for e in events:
+        by_ph[e["ph"]] = by_ph.get(e["ph"], 0) + 1
+    total = n_threads * per_thread
+    assert by_ph["X"] == total and by_ph["i"] == total
+    assert by_ph["b"] == total and by_ph["e"] == total
+    # every async begin has its end, per id
+    begins = {e["id"] for e in events if e["ph"] == "b"}
+    ends = {e["id"] for e in events if e["ph"] == "e"}
+    assert begins == ends and len(begins) == total
+    json.dumps(events)                      # structurally intact
+
+
 # -- bubble model ---------------------------------------------------------
 
 def test_bubble_fraction_schedule_model():
@@ -220,6 +327,50 @@ def test_ideal_step_time_anchors_measured():
     assert tm.ideal_step_time(1.0, 2, 1) == pytest.approx(0.5)
     # single stage: already bubble-free
     assert tm.ideal_step_time(1.0, 1, 4) == pytest.approx(1.0)
+
+
+def test_measured_bubble_and_drift():
+    from simple_distributed_machine_learning_tpu.telemetry.bubble import (
+        bubble_drift,
+        measured_bubble_fraction,
+    )
+
+    # a measured step exactly matching the slot model: drift reads zero
+    s, m = 4, 8
+    model = tm.schedule_bubble_fraction(s, m)
+    ideal = 1.0
+    measured = ideal / (1.0 - model)
+    assert measured_bubble_fraction(measured, ideal) == pytest.approx(model)
+    assert bubble_drift(s, m, "gpipe", measured, ideal) == pytest.approx(0.0)
+    # real stages idling longer than the model -> positive drift
+    assert bubble_drift(s, m, "gpipe", measured * 1.5, ideal) > 0
+    # a faster-than-ideal measurement clamps at 0 measured bubble
+    assert measured_bubble_fraction(0.5, 1.0) == 0.0
+    with pytest.raises(ValueError, match="step times"):
+        measured_bubble_fraction(0.0, 1.0)
+
+
+def test_session_emits_bubble_drift_with_reference(tmp_path):
+    """set_bubble_reference turns the epoch record's modeled bubble into a
+    checked one: measured + drift gauges appear only when a bubble-free
+    reference was supplied (never fabricated from the model itself)."""
+    class _Pipe:
+        n_stages, n_microbatches, schedule = 2, 2, "gpipe"
+
+    t = tm.Telemetry(str(tmp_path))
+    t.timer.record_window(0.4, steps=4)          # compile window
+    t.timer.record_window(0.4, steps=4)          # steady: 100 ms/step
+    rec = t.epoch_record(0, pipe=_Pipe())
+    assert "bubble_drift" not in rec             # no reference, no drift
+    # bubble-free reference: ideal 66.67 ms -> measured == model -> drift 0
+    model = tm.schedule_bubble_fraction(2, 2)
+    t.set_bubble_reference(0.1 * (1.0 - model))
+    rec = t.epoch_record(1, pipe=_Pipe())
+    assert rec["bubble_fraction_measured"] == pytest.approx(model,
+                                                            abs=2e-4)
+    assert rec["bubble_drift"] == pytest.approx(0.0, abs=2e-4)
+    with pytest.raises(ValueError, match="ideal_step_s"):
+        t.set_bubble_reference(0.0)
 
 
 # -- static ICI gauge -----------------------------------------------------
