@@ -782,6 +782,17 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                                       max_new=max_new,
                                       prompt_lens=prompt_lens,
                                       block_size=block_size)
+        # the fleet availability row: same question one level up — a whole
+        # replica killed mid-decode, its in-flight requests migrated onto
+        # the survivors from its journal alone (serve/fleet.py). The
+        # per-replica engine geometry matches the availability row's, so
+        # the --lint preflight and the build cache already cover it
+        rows += _measure_fleet_availability(stages, cfg,
+                                            slots=min(slots, 4),
+                                            n_requests=n_requests,
+                                            max_new=max_new,
+                                            prompt_lens=prompt_lens,
+                                            block_size=block_size)
     if default_shape:
         with open(os.path.join(REPO, "benchmarks", "serving.json"),
                   "w") as f:
@@ -1096,6 +1107,82 @@ def _measure_availability(stages, cfg, slots: int, n_requests: int,
         "restarts": s.get("restarts", 0),
         "recovered_requests": s.get("recovered_requests", 0),
         "postmortem_bundles": postmortems,
+        "faults_fired": plan.stats()["total_fired"],
+        "wall_s": round(wall, 3),
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }]
+
+
+def _measure_fleet_availability(stages, cfg, n_requests: int, max_new: int,
+                                prompt_lens: tuple, block_size: int,
+                                replicas: int = 3, slots: int = 4,
+                                deadline_s: float = 120.0,
+                                kill_tick: int = 5) -> list:
+    """Serving availability under a WHOLE-REPLICA loss: a 3-replica fleet
+    (``serve/fleet.py``) loses one replica mid-decode
+    (``replica-kill@fleet.tick``) and must migrate its in-flight requests
+    onto the survivors from the dead replica's journal alone.
+
+    ``availability`` = completed-within-deadline / submitted, like
+    :func:`_measure_availability` one level down — with the default
+    generous deadline the smoke shape pins availability == 1.0 with
+    ``replica_losses == 1`` and ``migrations >= 1``
+    (tests/test_fleet.py): losing a replica costs a migration, never a
+    completion."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.resilience import faults
+    from simple_distributed_machine_learning_tpu.serve import (
+        ServeFleet,
+        ServeMetrics,
+        engine_factory,
+    )
+
+    metrics = ServeMetrics()
+    plan = faults.install(faults.FaultPlan.parse(
+        f"replica-kill@fleet.tick={kill_tick}"))
+    tmpdir = tempfile.TemporaryDirectory(prefix="sdml-bench-fleet-")
+    try:
+        fleet = ServeFleet(
+            engine_factory(stages, cfg, n_slots=slots, kv_layout="paged",
+                           block_size=block_size, prefill_chunk=block_size,
+                           metrics=metrics),
+            tmpdir.name, n_replicas=replicas, metrics=metrics,
+            default_deadline_s=deadline_s)
+        rng = np.random.default_rng(0)
+        t0w = _time.perf_counter()
+        for i in range(n_requests):
+            fleet.submit(
+                rng.integers(0, cfg.vocab,
+                             prompt_lens[i % len(prompt_lens)]).astype(
+                                 np.int32),
+                max_new_tokens=max_new)
+        fleet.drain()
+        fleet.close()
+        wall = _time.perf_counter() - t0w
+    finally:
+        faults.uninstall()
+        tmpdir.cleanup()
+    s = metrics.summary()
+    completed = sum(1 for r in fleet.requests.values()
+                    if r.state == "done")
+    return [{
+        "config": "gpt_serve_fleet_availability_replica_loss",
+        "replicas": replicas, "n_slots": slots,
+        "n_requests": n_requests, "max_new_tokens": max_new,
+        "deadline_s": deadline_s, "kill_tick": kill_tick,
+        # the headline: completed-within-deadline fraction under the loss
+        "availability": round(completed / n_requests, 4),
+        "completed": completed,
+        "shed_deadline": s.get("shed_by_reason", {}).get("deadline", 0),
+        "replica_losses": fleet.replica_losses,
+        "migrations": fleet.migrations,
+        "affinity_hits": s.get("route_affinity_hits", 0),
         "faults_fired": plan.stats()["total_fired"],
         "wall_s": round(wall, 3),
         "device_kind": jax.devices()[0].device_kind,

@@ -225,6 +225,33 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument('--serve-max-restarts', type=int, default=3,
                    help="with --serve-chaos: engine-rebuild budget before "
                         "the serve supervisor fails the run loudly")
+    g.add_argument('--serve-replicas', type=int, default=0, metavar='N',
+                   help="with --serve-sim: serve through a FLEET of N "
+                        "supervised engine replicas behind a health-aware "
+                        "router (serve/fleet.py) — prefix-cache-affinity "
+                        "routing, per-replica journals "
+                        "(journal-r<i>.jsonl), and journal-backed "
+                        "cross-replica migration: killing a whole replica "
+                        "(--serve-chaos 'replica-kill@fleet.tick=5') "
+                        "re-admits its in-flight requests onto the "
+                        "survivors bit-exact from its journal alone. "
+                        "0 = the single-engine paths above")
+    g.add_argument('--serve-route',
+                   choices=("affinity", "least-loaded", "round-robin"),
+                   default="affinity",
+                   help="with --serve-replicas: routing policy — "
+                        "affinity routes to the replica whose paged pool "
+                        "already holds the prompt's registered prefix "
+                        "blocks (least-loaded fallback); least-loaded "
+                        "orders by queue depth then occupancy; "
+                        "round-robin is the affinity-blind baseline")
+    g.add_argument('--serve-autoscale', type=str, default=None,
+                   metavar='MIN,MAX',
+                   help="with --serve-replicas: enable the fleet "
+                        "autoscaler between MIN and MAX replicas — "
+                        "scale-out on sustained queue backlog (or paged "
+                        "KV residency), drain-then-retire on idle "
+                        "(serve/fleet.py::AutoscalePolicy)")
     g.add_argument('--serve-trace', action='store_true',
                    help="with --serve-sim/--scenario and --telemetry-dir: "
                         "request-scoped tracing (serve/tracing.py) — a "
@@ -728,6 +755,29 @@ def _run_serve(args, n_stages: int, key) -> None:
     if args.serve_max_restarts < 0:
         raise SystemExit(f"--serve-max-restarts must be >= 0, got "
                          f"{args.serve_max_restarts}")
+    if args.serve_replicas < 0:
+        raise SystemExit(f"--serve-replicas must be >= 0 (0 = single "
+                         f"engine), got {args.serve_replicas}")
+    if args.serve_route != "affinity" and not args.serve_replicas:
+        raise SystemExit("--serve-route needs --serve-replicas (a single "
+                         "engine has nothing to route between)")
+    autoscale = None
+    if args.serve_autoscale:
+        if not args.serve_replicas:
+            raise SystemExit("--serve-autoscale needs --serve-replicas")
+        from simple_distributed_machine_learning_tpu.serve import (
+            AutoscalePolicy,
+        )
+        try:
+            lo, hi = (int(v) for v in args.serve_autoscale.split(","))
+            autoscale = AutoscalePolicy(min_replicas=lo, max_replicas=hi)
+        except ValueError as e:
+            raise SystemExit(f"bad --serve-autoscale (expected MIN,MAX "
+                             f"integers): {e}") from None
+        if not lo <= args.serve_replicas <= hi:
+            raise SystemExit(
+                f"--serve-replicas {args.serve_replicas} outside the "
+                f"--serve-autoscale bounds [{lo}, {hi}]")
     serve_plan = None
     if args.serve_chaos:
         from simple_distributed_machine_learning_tpu.resilience import (
@@ -737,7 +787,18 @@ def _run_serve(args, n_stages: int, key) -> None:
             serve_plan = faults.FaultPlan.parse(args.serve_chaos)
         except ValueError as e:
             raise SystemExit(f"bad --serve-chaos spec: {e}") from None
-    supervised = bool(args.serve_chaos or args.serve_deadline_ms)
+        if not args.serve_replicas and any(
+                s.site == "fleet.tick" for s in serve_plan.specs):
+            # only the fleet probes fleet.tick: without replicas the spec
+            # would never fire and the drill would pass vacuously — the
+            # FaultSpec typo'd-site rule's CLI twin
+            raise SystemExit(
+                "--serve-chaos at site fleet.tick needs --serve-replicas "
+                "(a single engine never probes the fleet site, so the "
+                "fault would never fire)")
+    fleet_mode = args.serve_replicas > 0
+    supervised = (not fleet_mode
+                  and bool(args.serve_chaos or args.serve_deadline_ms))
     cfg = GPTConfig(vocab=256 if args.text_corpus else 128)
     if cfg.n_heads % args.serve_tp:
         raise SystemExit(f"--serve-tp {args.serve_tp} must divide the "
@@ -849,7 +910,41 @@ def _run_serve(args, n_stages: int, key) -> None:
         metrics=metrics, mesh=mesh, draft_stages=draft_stages,
         draft_cfg=draft_cfg, spec_k=args.serve_spec_k)
     tmpdir = None
-    if supervised:
+    if fleet_mode:
+        # the multi-replica path: N supervised engines behind the
+        # health-aware router — fleet-unique rids, per-replica journals,
+        # journal-backed cross-replica migration on replica loss
+        import tempfile
+
+        from simple_distributed_machine_learning_tpu.serve import (
+            ServeFleet,
+            engine_factory,
+        )
+        if args.telemetry_dir:
+            journal_dir = args.telemetry_dir
+        else:
+            tmpdir = tempfile.TemporaryDirectory(prefix="sdml-fleet-")
+            journal_dir = tmpdir.name
+        engine = ServeFleet(
+            engine_factory(stages, serve_cfg, **engine_kw), journal_dir,
+            n_replicas=args.serve_replicas, route=args.serve_route,
+            metrics=metrics, autoscale=autoscale,
+            max_restarts=args.serve_max_restarts,
+            default_deadline_s=(args.serve_deadline_ms / 1e3
+                                if args.serve_deadline_ms else None),
+            trace=trace,
+            # crash forensics whenever artifacts are kept, like the
+            # single-supervisor path: bundles are tagged -r<idx> so the
+            # replicas sharing this dir never collide
+            postmortem_dir=args.telemetry_dir or None)
+        print(f"| serve: fleet of {args.serve_replicas} replica(s), "
+              f"route {args.serve_route} (journals "
+              f"{journal_dir}/journal-r*.jsonl"
+              + (f", autoscale [{autoscale.min_replicas}, "
+                 f"{autoscale.max_replicas}]" if autoscale else "")
+              + (f", chaos {args.serve_chaos!r}" if args.serve_chaos
+                 else "") + ")")
+    elif supervised:
         # the crash-restartable path: the engine lives behind the serve
         # supervisor — journaled submissions/tokens, engine rebuild +
         # journal recovery on injected faults, deadline shedding
@@ -923,8 +1018,8 @@ def _run_serve(args, n_stages: int, key) -> None:
             faults.uninstall()
         for s, h in old_handlers.items():
             signal.signal(s, h)
-        if supervised:
-            engine.close()             # journal flushed + closed
+        if supervised or fleet_mode:
+            engine.close()             # journal(s) flushed + closed
         if trace is not None:
             trace.close()              # chrome trace + timeline flushed
     s = metrics.summary()
@@ -934,6 +1029,16 @@ def _run_serve(args, n_stages: int, key) -> None:
           f"ttft p50/p95 {s['ttft_ms_p50']}/{s['ttft_ms_p95']} ms, "
           f"tpot p50/p95 {s['tpot_ms_p50']}/{s['tpot_ms_p95']} ms, "
           f"occupancy {s['slot_occupancy_mean']}")
+    if fleet_mode:
+        print(f"| serve: fleet {engine.n_alive} alive "
+              f"({engine.n_in_rotation} in rotation), "
+              f"{engine.replica_losses} replica loss(es), "
+              f"{engine.migrations} migration(s), "
+              f"{s.get('route_affinity_hits', 0)} affinity hit(s), "
+              f"{s.get('fleet_scale_outs', 0)} scale-out(s), "
+              f"{s.get('fleet_retired', 0)} retired, "
+              f"{s.get('restarts', 0)} in-place restart(s), "
+              f"journals {s.get('journal_bytes', 0)} bytes")
     if supervised:
         print(f"| serve: supervisor {engine.state}, "
               f"{s.get('restarts', 0)} restart(s), "
@@ -1026,6 +1131,17 @@ def _run_scenario(args, n_stages: int, key) -> None:
              if report.get("supervised") else "")
           + f"faults fired: "
           f"{report.get('faults', {}).get('total_fired', 0)}")
+    fl = report.get("fleet")
+    if fl:
+        print(f"| scenario: fleet {fl['replicas']} replica(s) "
+              f"(route {fl['route']}): {fl['replica_losses']} loss(es), "
+              f"{fl['migrations']} migration(s), "
+              f"{fl['affinity_hits']} affinity hit(s), "
+              f"{fl['scale_outs']} scale-out(s), {fl['retired']} retired")
+        for ev in fl["replica_log"]:
+            print(f"| scenario:   fleet {ev['event']} replica "
+                  f"{ev['replica']} @tick {ev['tick']} "
+                  f"(t={ev['t']:g}, {ev['alive']} alive)")
     for cls, att in sorted(report["slo"].items()):
         parts = []
         if "ttft_attainment" in att:

@@ -36,6 +36,7 @@ _EXPORTS = {
     "DeviceWedged": ".faults",
     "CheckpointWriteCrash": ".faults",
     "EngineCrash": ".faults",
+    "ReplicaLost": ".faults",
     "CheckpointStore": ".store",
     "ElasticTrainer": ".supervisor",
     "PeerLost": ".supervisor",

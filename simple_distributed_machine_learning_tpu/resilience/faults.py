@@ -37,6 +37,14 @@ Fault kinds and their standard effects (applied by :func:`maybe_fire`):
                      (``serve/supervisor.py``) discards the engine
                      wholesale and re-admits in-flight requests from the
                      request journal
+``replica-kill``     a whole serving REPLICA (supervisor + engine +
+                     in-memory journal handle) dies at a fleet tick: the
+                     fleet (``serve/fleet.py``) interprets it itself via
+                     :func:`check` — the replica drops from rotation and
+                     its in-flight requests migrate onto surviving
+                     replicas from its on-disk journal alone; a bare
+                     :func:`maybe_fire` at the site raises
+                     :class:`ReplicaLost`
 =================== ==================================================
 
 Injection sites threaded through the stack:
@@ -47,6 +55,11 @@ Injection sites threaded through the stack:
 - ``serve.admit``         (``serve/engine.py::submit``, ctx: ``step`` = rid —
                           a crash while a request is being accepted, the
                           journaled-but-never-admitted corner)
+- ``fleet.tick``          (``serve/fleet.py``, ctx: ``step`` = fleet tick,
+                          ``rank`` = replica index — the fleet probes the
+                          site once per alive replica per tick, so
+                          ``rank=N`` targets replica N and a rank-less spec
+                          kills the lowest-indexed alive replica)
 - ``watchdog.heartbeat``  (``utils/failure.py``, ctx: ``rank``)
 - ``bench.probe``         (``bench.py``, ctx: ``step`` = probe attempt)
 
@@ -72,10 +85,10 @@ import os
 import time
 
 KINDS = ("host-kill", "frozen-peer", "slow-tick", "ckpt-write-crash",
-         "wedged-device", "engine-crash")
+         "wedged-device", "engine-crash", "replica-kill")
 
 SITES = ("train.step", "ckpt.write", "serve.tick", "serve.admit",
-         "watchdog.heartbeat", "bench.probe")
+         "fleet.tick", "watchdog.heartbeat", "bench.probe")
 
 ENV_VAR = "SDML_CHAOS"
 
@@ -112,6 +125,13 @@ class EngineCrash(FaultInjected):
     rebuild from scratch and recover in-flight requests from the journal."""
 
 
+class ReplicaLost(FaultInjected):
+    """A whole serving replica died (injected): supervisor, engine and
+    every in-memory structure are gone; the fleet (``serve/fleet.py``)
+    must migrate its in-flight requests onto surviving replicas from the
+    dead replica's on-disk journal alone."""
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     """One scheduled fault; see the module docstring for field semantics."""
@@ -135,6 +155,15 @@ class FaultSpec:
             raise ValueError(
                 f"unknown fault site {self.site!r}; instrumented sites: "
                 f"{SITES}")
+        if (self.kind == "replica-kill") != (self.site == "fleet.tick"):
+            # the fleet interprets ONLY replica-kill at its site, and no
+            # other instrumented site probes that kind — any crossed pair
+            # would match-and-count without ever taking effect, the
+            # vacuous-drill failure the strict site check exists to stop
+            raise ValueError(
+                f"kind {self.kind!r} at site {self.site!r}: replica-kill "
+                f"and fleet.tick only pair with each other (the fleet is "
+                f"the sole interpreter of both)")
         if self.after < 0 or self.times < 0 or self.dur < 0:
             raise ValueError(
                 f"after/times/dur must be >= 0, got {self.after}/"
@@ -253,6 +282,10 @@ class FaultPlan:
                 raise DeviceWedged(spec, site)
             if spec.kind == "engine-crash":
                 raise EngineCrash(spec, site)
+            if spec.kind == "replica-kill":
+                # the fleet interprets this kind via check() and never gets
+                # here; a bare maybe_fire caller still fails loudly
+                raise ReplicaLost(spec, site)
             if spec.kind == "ckpt-write-crash":
                 tmp = ctx.get("tmp")
                 if tmp:

@@ -50,6 +50,26 @@ The catalog (also in docs/ARCHITECTURE.md):
                      (completed or structurally shed, none lost); the
                      no-deadline FCFS baseline fails the same gate
                      (tests pin both sides on exact numbers)
+``fleet-replica-loss`` steady traffic over a 3-replica fleet
+                     (``serve/fleet.py``) with a whole replica killed
+                     mid-decode (``replica-kill@fleet.tick``): the dead
+                     replica's in-flight requests migrate onto survivors
+                     from its journal alone — the gate requires ALL
+                     requests complete, ≥ 1 migration actually happened,
+                     and the SLOs held through the loss (bit-exactness of
+                     every migrated stream is pinned in tests/
+                     test_fleet.py)
+``hot-prefix-skew``  every request shares one system prefix: the
+                     prefix-cache-aware router concentrates the prefix's
+                     blocks on one replica (affinity) instead of paying
+                     its prefill on every replica (round-robin) — tests
+                     pin affinity's prefix-hit counters STRICTLY above
+                     round-robin's on this exact workload
+``fleet-autoscale-diurnal`` a compressed day/night arrival cycle over an
+                     autoscaled fleet (min 1, max 3): sustained backlog
+                     scales out, the idle trough drains-then-retires —
+                     the exact virtual-clock replica-count trajectory
+                     (``ServeFleet.replica_log``) is pinned in tests
 =================== =====================================================
 
 Supervised scenarios (``Scenario.supervised``) run through the
@@ -66,6 +86,10 @@ import dataclasses
 import os
 
 from simple_distributed_machine_learning_tpu.resilience import faults
+from simple_distributed_machine_learning_tpu.serve.fleet import (
+    AutoscalePolicy,
+    ServeFleet,
+)
 from simple_distributed_machine_learning_tpu.serve.metrics import ServeMetrics
 from simple_distributed_machine_learning_tpu.serve.scheduler import (
     FCFSScheduler,
@@ -131,6 +155,15 @@ class Scenario:
     # (a crash scenario whose fault never fired must FAIL, not pass
     # vacuously — the FaultSpec site check's dynamic twin)
     min_restarts: int = 0
+    # fleet scenarios (serve/fleet.py): replicas > 0 runs the traffic
+    # through a ServeFleet of that many supervised replicas behind the
+    # route policy; min_migrations is the fleet chaos gate (a replica-loss
+    # scenario whose kill never migrated anything must FAIL, not pass
+    # vacuously), autoscale enables the queue-depth/KV autoscaler
+    replicas: int = 0
+    route: str = "affinity"
+    autoscale: "object | None" = None       # AutoscalePolicy
+    min_migrations: int = 0
 
     def __post_init__(self):
         if self.scheduler not in ("fcfs", "priority"):
@@ -144,10 +177,26 @@ class Scenario:
                 "min_restarts needs supervised=True (only the supervisor "
                 "restarts an engine)")
         if (self.overload is not None or self.allow_shed) \
-                and not self.supervised:
+                and not (self.supervised or self.replicas):
             raise ValueError(
-                "overload/allow_shed need supervised=True (admission "
-                "control and shedding live in the supervisor)")
+                "overload/allow_shed need supervised=True or a fleet "
+                "(admission control and shedding live in the supervisor)")
+        if self.replicas:
+            if self.supervised:
+                raise ValueError(
+                    "replicas > 0 already runs every replica through its "
+                    "own ServeSupervisor — drop supervised=True")
+            from simple_distributed_machine_learning_tpu.serve.router import (  # noqa: E501
+                POLICIES,
+            )
+            if self.route not in POLICIES:
+                raise ValueError(f"route must be one of {POLICIES}, got "
+                                 f"{self.route!r}")
+        elif (self.min_migrations or self.autoscale is not None
+              or self.route != "affinity"):
+            raise ValueError(
+                "route/autoscale/min_migrations are fleet knobs — set "
+                "replicas > 0")
 
 
 # SLO targets are VIRTUAL milliseconds (see module docstring): an engine
@@ -248,13 +297,63 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
                                 degrade_queue_depth=6,
                                 recover_queue_depth=2,
                                 degraded_priority_floor=0)),
+    Scenario(
+        name="fleet-replica-loss",
+        description="steady interactive traffic over a 3-replica fleet "
+                    "with a whole replica killed mid-decode: its in-flight "
+                    "requests migrate onto the survivors from its journal "
+                    "alone (gate: all complete AND >= 1 migration actually "
+                    "happened; per-stream bit-exactness is pinned in "
+                    "tests/test_fleet.py)",
+        sim=SimConfig(n_requests=16, rate=12.0, seed=0,
+                      classes=(dataclasses.replace(_INTERACTIVE,
+                                                   weight=1.0),)),
+        n_slots=2, prefill_chunk=4, scheduler="fcfs",
+        replicas=3, chaos="replica-kill@fleet.tick=5",
+        min_migrations=1),
+    Scenario(
+        name="hot-prefix-skew",
+        description="every request shares one 8-token system prefix: the "
+                    "prefix-cache-aware router keeps the prefix's blocks "
+                    "hot on one replica instead of re-prefilling them on "
+                    "all three — tests pin affinity's prefix-hit counters "
+                    "strictly above round-robin's on this exact workload",
+        sim=SimConfig(n_requests=18, rate=16.0, seed=0,
+                      shared_prefix_len=8,
+                      classes=(dataclasses.replace(_INTERACTIVE,
+                                                   weight=1.0),)),
+        n_slots=2, block_size=8, prefill_chunk=4, scheduler="fcfs",
+        replicas=3, route="affinity"),
+    Scenario(
+        name="fleet-autoscale-diurnal",
+        description="a compressed day/night arrival cycle over an "
+                    "autoscaled fleet (min 1, max 3): sustained backlog "
+                    "scales out, the idle trough drains-then-retires; the "
+                    "exact virtual-clock replica-count trajectory "
+                    "(ServeFleet.replica_log) is pinned in tests",
+        # calibrated so ONE virtual-clock run walks the whole autoscaler
+        # state machine: the first peak scales 1 -> 3, the trough
+        # drains-then-retires back to 1, the second peak scales out again
+        # (tests/test_fleet.py pins the exact tick/replica trajectory)
+        sim=SimConfig(n_requests=50, rate=60.0, seed=0, arrival="diurnal",
+                      diurnal_amplitude=0.95, period_s=0.6,
+                      classes=(dataclasses.replace(
+                          _INTERACTIVE, weight=1.0, ttft_slo_ms=None,
+                          tpot_slo_ms=None),)),
+        n_slots=2, prefill_chunk=4, scheduler="fcfs",
+        replicas=1,
+        autoscale=AutoscalePolicy(min_replicas=1, max_replicas=3,
+                                  scale_out_queue_depth=4,
+                                  scale_out_ticks=2,
+                                  retire_idle_s=0.08)),
 )}
 
 
 def run_scenario(scenario: Scenario | str, stages, cfg, *,
                  outdir: str | None = None, scheduler: str | None = None,
                  virtual: bool = True, per_call_s: float = 0.001,
-                 supervised: bool | None = None, trace=None) -> dict:
+                 supervised: bool | None = None, trace=None,
+                 route: str | None = None) -> dict:
     """Run one scenario end to end; returns the report with the SLO block.
 
     ``stages``/``cfg``: a ``make_gpt_stages`` build (the engine's usual
@@ -268,6 +367,17 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     ``metrics.jsonl`` + ``metrics.prom`` — the artifact CI's chaos job
     parses; supervised runs additionally write a post-mortem bundle per
     restart / drain-timeout / shed burst into ``outdir``.
+
+    Fleet scenarios (``scenario.replicas > 0``) run through a
+    :class:`~..serve.fleet.ServeFleet` of that many supervised replicas;
+    ``route`` overrides the scenario's routing policy (the
+    affinity-vs-round-robin comparison tests use this the way the
+    FCFS-vs-priority tests use ``scheduler``), the per-replica journals
+    land next to the metrics as ``journal-<name>-r<idx>.jsonl``, and the
+    report gains a ``"fleet"`` block (replica losses, migrations,
+    affinity hits, scale events, the replica-count trajectory).
+    ``report["slo_ok"]`` then additionally requires at least
+    ``min_migrations`` cross-replica migrations to have happened.
 
     ``trace`` enables request-scoped tracing (``serve/tracing.py``):
     ``True`` builds a :class:`~..serve.tracing.ServeTrace` (written to
@@ -297,6 +407,8 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     policy = scheduler or scenario.scheduler
     sched_cls = PriorityScheduler if policy == "priority" else FCFSScheduler
     sup_flag = scenario.supervised if supervised is None else supervised
+    fleet_flag = scenario.replicas > 0
+    route_policy = route or scenario.route
 
     plan = None
     if scenario.chaos:
@@ -320,9 +432,29 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                          block_size=scenario.block_size,
                          prefill_chunk=scenario.prefill_chunk,
                          scheduler=sched_cls, metrics=metrics, clock=clock)
-        if trace and not sup_flag:
+        if trace and not (sup_flag or fleet_flag):
             engine_kw["trace"] = trace
-        if sup_flag:
+        if fleet_flag:
+            if outdir:
+                jdir = outdir
+            else:
+                tmpdir = tempfile.TemporaryDirectory(prefix="sdml-fleet-")
+                jdir = tmpdir.name
+            target = ServeFleet(
+                engine_factory(stages, cfg, **engine_kw), jdir,
+                n_replicas=scenario.replicas, route=route_policy,
+                metrics=metrics, clock=clock,
+                autoscale=scenario.autoscale,
+                max_restarts=scenario.max_restarts,
+                degrade_after=scenario.degrade_after,
+                overload=scenario.overload,
+                trace=trace or None,
+                # virtual-clock runs measure scheduling structure, not
+                # durability (the supervised branch's sync rule)
+                journal_sync=not virtual,
+                journal_prefix=f"journal-{scenario.name}-r",
+                postmortem_dir=outdir)
+        elif sup_flag:
             if outdir:
                 jpath = os.path.join(outdir,
                                      f"journal-{scenario.name}.jsonl")
@@ -356,7 +488,7 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     finally:
         if plan is not None:
             faults.uninstall()
-        if sup_flag and target is not None:
+        if (sup_flag or fleet_flag) and target is not None:
             target.close()
         if trace and trace is not True:
             # `trace` stays the bool if setup raised before the recorder
@@ -377,6 +509,22 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
         report["supervisor_state"] = target.state
         report["postmortem_bundles"] = len(target.postmortems)
         ok &= target.restarts >= scenario.min_restarts
+    if fleet_flag:
+        report["fleet"] = {
+            "replicas": scenario.replicas,
+            "route": route_policy,
+            "alive": target.n_alive,
+            "in_rotation": target.n_in_rotation,
+            "replica_losses": target.replica_losses,
+            "migrations": target.migrations,
+            "affinity_hits": int(metrics.route_affinity_hits.value),
+            "scale_outs": int(metrics.fleet_scale_outs.value),
+            "retired": int(metrics.fleet_retired.value),
+            "replica_log": list(target.replica_log),
+        }
+        report["restarts"] = sum(
+            r.supervisor.restarts for r in target.replicas)
+        ok &= target.migrations >= scenario.min_migrations
     if trace:
         report["trace_events"] = trace.n_events
     for tc in scenario.sim.classes:
@@ -411,6 +559,8 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
             "completed": report["completed"], "shed": report["shed"],
             "n_requests": report["n_requests"], "slo": slo, "slo_ok": ok,
             **({"restarts": report["restarts"]} if sup_flag else {}),
+            **({"fleet": {k: v for k, v in report["fleet"].items()
+                          if k != "replica_log"}} if fleet_flag else {}),
             **({"faults_fired": plan.stats()["total_fired"]}
                if plan is not None else {}),
         })

@@ -52,7 +52,19 @@ checkpoint restore, and the telemetry registry:
   TTFT/total deadlines at tick boundaries, and applies
   :class:`OverloadPolicy` admission control (per-class token buckets,
   queue-depth backpressure, degraded modes) — ``cli.py --serve-chaos`` /
-  ``--serve-deadline-ms``.
+  ``--serve-deadline-ms``;
+- :mod:`.router` — :class:`FleetRouter`: which replica serves a request —
+  prefix-cache affinity over the paged pools' registries first,
+  least-loaded by queue-depth/occupancy otherwise, round-robin as the
+  affinity-blind baseline;
+- :mod:`.fleet` — :class:`ServeFleet` + :class:`AutoscalePolicy`: N
+  supervised replicas behind the router with fleet-unique rids,
+  health-aware rotation (hysteresis re-entry), JOURNAL-BACKED
+  cross-replica migration on replica loss (every in-flight stream
+  re-admitted onto survivors bit-exact from the dead replica's journal
+  alone), and a queue-depth/KV-residency autoscaler (scale-out on
+  sustained backlog, drain-then-retire on idle) —
+  ``cli.py --serve-replicas``.
 
 Correctness anchor (tests/test_serve.py): with the same seed, every
 request's tokens are bit-exact vs decoding it alone through
@@ -63,6 +75,10 @@ optimization, not a math change.
 from simple_distributed_machine_learning_tpu.serve.engine import (  # noqa: F401
     DrainTimeout,
     InferenceEngine,
+)
+from simple_distributed_machine_learning_tpu.serve.fleet import (  # noqa: F401
+    AutoscalePolicy,
+    ServeFleet,
 )
 from simple_distributed_machine_learning_tpu.serve.flight import (  # noqa: F401
     FlightRecorder,
@@ -76,6 +92,9 @@ from simple_distributed_machine_learning_tpu.serve.metrics import (  # noqa: F40
 )
 from simple_distributed_machine_learning_tpu.serve.request import (  # noqa: F401
     Request,
+)
+from simple_distributed_machine_learning_tpu.serve.router import (  # noqa: F401
+    FleetRouter,
 )
 from simple_distributed_machine_learning_tpu.serve.scheduler import (  # noqa: F401
     FCFSScheduler,

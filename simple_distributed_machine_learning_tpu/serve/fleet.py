@@ -1,0 +1,537 @@
+"""The multi-replica serving fleet: N supervised engines behind one router.
+
+PR 10 made ONE engine crash-restartable; this module is the layer the
+ROADMAP's "heavy traffic from millions of users" north star actually
+deploys — N :class:`~.supervisor.ServeSupervisor`-wrapped replicas behind
+a :class:`~.router.FleetRouter`, surviving the loss of any replica without
+losing a single in-flight token stream:
+
+**Routing.** Submissions go to an IN-ROTATION replica picked by the
+router: prefix-cache affinity first (the replica whose paged pool already
+holds the prompt's registered prefix blocks), least-loaded by
+queue-depth/occupancy otherwise (``serve/router.py``). Rids are
+fleet-unique (the fleet owns the id space and seeds each replica's engine
+counter before every submit), so journals, traces and metrics from
+different replicas never collide on a request id.
+
+**Health-aware rotation.** A replica leaves rotation the tick its
+supervisor is anything but cleanly RUNNING — a restart consumed
+(RECOVERING happened inside the tick), a degraded mode latched, overload
+lockout — and re-enters only after ``health_recover_ticks`` consecutive
+healthy ticks (hysteresis: one good tick after a crash loop must not pull
+traffic back). Out-of-rotation replicas keep ticking and draining; they
+just stop receiving new work. If rotation empties entirely, routing falls
+back to any alive replica — the fleet never refuses work it could serve.
+
+**Journal-backed cross-replica migration** (the headline robustness
+property). A ``replica-kill@fleet.tick`` fault (``resilience/faults.py``)
+— or a replica whose supervisor exhausts its restart budget — kills a
+whole replica: supervisor, engine, every in-memory structure. The fleet
+trusts ONLY the dead replica's on-disk journal: ``read_journal`` +
+``recover_state`` rebuild the in-flight picture, each live handle is
+rewound to its journaled prefix (``ServeSupervisor._apply_snapshot``),
+and the survivors ADOPT the in-flight requests in rid order —
+``ServeSupervisor.adopt`` journals the full snapshot into the adopting
+replica's journal first (so a second loss, or a crash of the adopter,
+replays it like a native submission) and re-admits through
+``engine.restore``, the same preempt/resume path crash recovery uses.
+Every migrated request's full token stream is bit-exact vs the
+uninterrupted single-replica run — across double replica loss and a loss
+landing during another replica's crash recovery (tests/test_fleet.py).
+
+**Autoscaling** (:class:`AutoscalePolicy`). Scale-out: when the fleet's
+total queue depth (or the paged pools' resident-block fraction — the
+``serve_kv_bytes_resident`` signal) sits at/above the high watermark for
+``scale_out_ticks`` consecutive fleet ticks, a fresh replica spawns (up
+to ``max_replicas``). Drain-then-retire: a replica idle for
+``retire_idle_s`` of virtual/wall time leaves rotation and retires (its
+journal stays on disk; every request it served is complete), down to
+``min_replicas``. Both transitions land in :attr:`ServeFleet.replica_log`
+with their fleet tick and timestamp — what the diurnal autoscale scenario
+pins exactly.
+
+The fleet duck-types the engine surface the simulator and scenario runner
+drive (``submit``/``step``/``drain``/``busy``/``requests``/``metrics``/
+``cfg``/``_clock``) and reads the clock NEVER — all timestamps come from
+arrival times and the replicas' own engine reads, so virtual-clock
+scenario numbers are exact and machine-independent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from simple_distributed_machine_learning_tpu.resilience import faults
+from simple_distributed_machine_learning_tpu.serve.journal import (
+    RequestJournal,
+    read_journal,
+    recover_state,
+)
+from simple_distributed_machine_learning_tpu.serve.request import (
+    ACTIVE,
+    DONE,
+    QUEUED,
+    Request,
+)
+from simple_distributed_machine_learning_tpu.serve.router import FleetRouter
+from simple_distributed_machine_learning_tpu.serve.supervisor import (
+    RUNNING,
+    ServeSupervisor,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Autoscaler knobs; see the module docstring.
+
+    ``scale_out_queue_depth`` is the FLEET-TOTAL queued-request high
+    watermark; ``kv_frac_high`` optionally adds the paged-pool signal
+    (blocks in use / blocks total across alive replicas — the block-count
+    form of ``serve_kv_bytes_resident`` over capacity; None disables).
+    Either signal held for ``scale_out_ticks`` consecutive fleet ticks
+    spawns one replica. ``retire_idle_s`` is how long a replica must sit
+    idle (no queued or active work) before it drains out and retires."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_out_queue_depth: int = 4
+    scale_out_ticks: int = 3
+    retire_idle_s: float = 0.5
+    kv_frac_high: float | None = None
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, got "
+                             f"{self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas {self.max_replicas} must be >= min_replicas "
+                f"{self.min_replicas}")
+        if self.scale_out_queue_depth < 1 or self.scale_out_ticks < 1:
+            raise ValueError(
+                f"scale_out_queue_depth/scale_out_ticks must be >= 1, got "
+                f"{self.scale_out_queue_depth}/{self.scale_out_ticks}")
+        if self.retire_idle_s <= 0:
+            raise ValueError(f"retire_idle_s must be > 0, got "
+                             f"{self.retire_idle_s}")
+        if self.kv_frac_high is not None and not 0 < self.kv_frac_high <= 1:
+            raise ValueError(f"kv_frac_high must be in (0, 1], got "
+                             f"{self.kv_frac_high}")
+
+
+@dataclasses.dataclass(eq=False)
+class _Replica:
+    """One fleet member's bookkeeping (identity-hashed: each record IS its
+    replica)."""
+
+    idx: int
+    supervisor: ServeSupervisor
+    journal_path: str
+    alive: bool = True
+    in_rotation: bool = True
+    healthy_streak: int = 0
+    last_restarts: int = 0
+    # the timestamp the fleet FIRST OBSERVED this replica idle (None while
+    # busy or never yet checked). An observation anchor, not a clock read:
+    # seeding it from spawn time would break wall-clock runs, where the
+    # fleet's _now jumps from 0 to an absolute monotonic value and any
+    # 0-anchored idle gap would read as hours
+    idle_since: float | None = None
+
+
+class ServeFleet:
+    """N supervised replicas behind a health-aware router; see the module
+    docstring.
+
+    ``factory(degraded) -> InferenceEngine`` is the SHARED engine factory
+    (``supervisor.engine_factory``) every replica's supervisor rebuilds
+    through; replicas journal into ``journal_dir`` as
+    ``journal-r<idx>.jsonl`` (pre-existing fleet journals there are
+    removed — each fleet run starts fresh). ``metrics``/``clock``/
+    ``trace`` are shared across replicas: counters and histograms
+    aggregate fleet-wide, rids are fleet-unique so traces join, and the
+    per-replica gauges are last-writer-wins by design. Supervisor knobs
+    (``max_restarts``/``degrade_after``/``overload``/deadline defaults)
+    apply to every replica alike.
+    """
+
+    def __init__(self, factory, journal_dir: str, *, n_replicas: int = 2,
+                 route: str = "affinity", metrics=None,
+                 clock=time.monotonic, autoscale: AutoscalePolicy | None
+                 = None, max_restarts: int = 3,
+                 degrade_after: int | None = None, overload=None,
+                 default_ttft_deadline_s: float | None = None,
+                 default_deadline_s: float | None = None, trace=None,
+                 health_recover_ticks: int = 2,
+                 journal_sync: bool = True,
+                 journal_prefix: str = "journal-r",
+                 postmortem_dir: str | None = None) -> None:
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if health_recover_ticks < 1:
+            raise ValueError(f"health_recover_ticks must be >= 1, got "
+                             f"{health_recover_ticks}")
+        if autoscale is not None and not (autoscale.min_replicas
+                                          <= n_replicas
+                                          <= autoscale.max_replicas):
+            raise ValueError(
+                f"n_replicas {n_replicas} outside the autoscale bounds "
+                f"[{autoscale.min_replicas}, {autoscale.max_replicas}]")
+        self.factory = factory
+        self.journal_dir = journal_dir
+        self.metrics = metrics
+        self._clock = clock
+        self.router = FleetRouter(route)
+        self.autoscale = autoscale
+        self.health_recover_ticks = int(health_recover_ticks)
+        self.journal_sync = journal_sync
+        self._sup_kw = dict(
+            max_restarts=max_restarts, degrade_after=degrade_after,
+            overload=overload,
+            default_ttft_deadline_s=default_ttft_deadline_s,
+            default_deadline_s=default_deadline_s,
+            # every replica dumps crash forensics into the SHARED dir;
+            # the per-replica postmortem_tag keeps the bundle names apart
+            postmortem_dir=postmortem_dir)
+        self.trace = trace
+        self.journal_prefix = journal_prefix
+        os.makedirs(journal_dir, exist_ok=True)
+        import glob
+        for stale in glob.glob(os.path.join(journal_dir,
+                                            f"{journal_prefix}*.jsonl")):
+            os.unlink(stale)               # each fleet run journals fresh
+        self.replicas: list[_Replica] = []
+        self._next_idx = 0
+        #: the fleet-owned rid space: every replica's engine counter is
+        #: seeded from this before each submit, so rids are fleet-unique
+        self._next_rid = 0
+        self.requests: dict[int, Request] = {}
+        self._home: dict[int, int] = {}        # rid -> serving replica idx
+        self._user_cb: dict[int, object] = {}  # rid -> caller's on_token
+        #: monotonic fleet tick (every replica steps once per fleet tick)
+        self.tick = 0
+        self._now = 0.0       # newest timestamp the fleet has SEEN (never
+        #                       a clock read of its own)
+        self._backlog_ticks = 0
+        self.replica_losses = 0
+        self.migrations = 0
+        #: dynamic fleet events — (tick, t, event, replica, alive count) —
+        #: the trajectory the autoscale/loss scenarios pin exactly
+        self.replica_log: list[dict] = []
+        for _ in range(n_replicas):
+            self._spawn_replica(log=None)
+
+    # -- replica lifecycle ---------------------------------------------------
+
+    def _spawn_replica(self, log: str | None) -> _Replica:
+        idx = self._next_idx
+        self._next_idx += 1
+        path = os.path.join(self.journal_dir,
+                            f"{self.journal_prefix}{idx}.jsonl")
+        sup = ServeSupervisor(
+            self.factory, RequestJournal(path, sync=self.journal_sync),
+            metrics=self.metrics, clock=self._clock, trace=self.trace,
+            postmortem_tag=f"-r{idx}", **self._sup_kw)
+        rep = _Replica(idx=idx, supervisor=sup, journal_path=path)
+        self.replicas.append(rep)
+        if log is not None:
+            self._log_event(log, rep)
+            if self.metrics is not None and log == "scale-out":
+                self.metrics.on_scale_out()
+        return rep
+
+    def _log_event(self, event: str, rep: _Replica) -> None:
+        self.replica_log.append({
+            "tick": self.tick, "t": round(self._now, 6), "event": event,
+            "replica": rep.idx, "alive": self.n_alive})
+
+    def _alive(self) -> list[_Replica]:
+        return [r for r in self.replicas if r.alive]
+
+    def _rotation(self) -> list[_Replica]:
+        return [r for r in self.replicas if r.alive and r.in_rotation]
+
+    @property
+    def n_alive(self) -> int:
+        return sum(1 for r in self.replicas if r.alive)
+
+    @property
+    def n_in_rotation(self) -> int:
+        return len(self._rotation())
+
+    # -- the engine surface --------------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        return any(r.supervisor.busy for r in self._alive())
+
+    @property
+    def cfg(self):
+        return self._alive()[0].supervisor.cfg
+
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               top_k: int | None = None, top_p: float | None = None,
+               eos_id: int | None = None, seed: int | None = None,
+               on_token=None, arrival_time: float | None = None,
+               cls: str | None = None, priority: int = 0,
+               ttft_deadline_s: float | None = None,
+               deadline_s: float | None = None) -> Request:
+        """Route one submission to an in-rotation replica (affinity first,
+        least-loaded fallback — ``serve/router.py``) and submit through
+        its supervisor: journaled, admission-controlled, deadline-bound
+        exactly as a single supervised engine would."""
+        if arrival_time is not None:
+            self._now = max(self._now, arrival_time)
+            self._retire_idle()   # idle troughs advance via arrivals, not
+            #                       ticks — check drain-then-retire here too
+        from simple_distributed_machine_learning_tpu.resilience.supervisor import (  # noqa: E501
+            RestartBudgetExceeded,
+        )
+        candidates = self._rotation() or self._alive()
+        rep, hit = self.router.route(prompt, candidates)
+        if hit and self.metrics is not None:
+            self.metrics.on_affinity_hit()
+        rid = self._next_rid
+        rep.supervisor.engine._next_rid = rid
+        self._user_cb[rid] = on_token
+        try:
+            h = rep.supervisor.submit(
+                prompt, max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_id=eos_id, seed=seed,
+                on_token=on_token, arrival_time=arrival_time, cls=cls,
+                priority=priority, ttft_deadline_s=ttft_deadline_s,
+                deadline_s=deadline_s)
+        except RestartBudgetExceeded as e:
+            # an admission crash (serve.admit site) with the replica's
+            # restart budget already spent: a replica LOSS, not a fleet
+            # crash. The submission was journaled before the engine saw it,
+            # so the loss migration re-admits it on a survivor — the
+            # caller's handle is the journal-recovered one
+            self._lose_replica(rep, f"RestartBudgetExceeded@submit: {e}")
+            self._next_rid += 1
+            return self.requests[rid]
+        self._next_rid += 1
+        self.requests[h.rid] = h
+        self._home[h.rid] = rep.idx
+        return h
+
+    def step(self) -> int:
+        """One fleet tick: interpret scheduled replica-kill faults, step
+        every alive replica once (a supervisor that exhausts its restart
+        budget is treated as a replica loss, not a fleet crash), update
+        health/rotation with hysteresis, run the autoscaler, refresh the
+        fleet gauges. Returns tokens emitted fleet-wide."""
+        from simple_distributed_machine_learning_tpu.resilience.supervisor import (  # noqa: E501
+            RestartBudgetExceeded,
+        )
+        self.tick += 1
+        # the fleet.tick fault site: probed once per alive replica (rank =
+        # replica idx), interpreted HERE — check(), not fire(), exactly
+        # like the watchdog's frozen-peer
+        for rep in self._alive():
+            if not rep.alive:      # died earlier in THIS probe sweep
+                continue
+            for spec in faults.check("fleet.tick", step=self.tick,
+                                     rank=rep.idx):
+                if spec.kind == "replica-kill":
+                    self._lose_replica(rep, f"replica-kill@tick{self.tick}")
+                    break
+        emitted = 0
+        for rep in self._alive():
+            try:
+                emitted += rep.supervisor.step()
+            except RestartBudgetExceeded as e:
+                # a replica that cannot hold an engine anymore is a LOST
+                # replica: its in-flight work migrates, the fleet lives on
+                self._lose_replica(rep, f"RestartBudgetExceeded: {e}")
+                continue
+            self._update_health(rep)
+        if self.autoscale is not None:
+            self._autoscale_step()
+        if self.metrics is not None:
+            self.metrics.set_fleet_replicas(self.n_in_rotation)
+            self.metrics.set_journal_bytes(
+                sum(r.supervisor.journal.bytes for r in self._alive()))
+        return emitted
+
+    def drain(self, max_ticks: int | None = None) -> list[Request]:
+        from simple_distributed_machine_learning_tpu.serve.engine import (
+            DrainTimeout,
+        )
+        ticks = 0
+        while self.busy:
+            if max_ticks is not None and ticks >= max_ticks:
+                exc = DrainTimeout(max_ticks, [
+                    r for r in self.requests.values()
+                    if r.state in (QUEUED, ACTIVE)])
+                # the wedged-drain forensics the supervised path dumps:
+                # one tagged bundle per alive replica (each sees its own
+                # flight rows / requests / journal tail), BEFORE the
+                # raise — no-ops without a configured postmortem_dir
+                for rep in self._alive():
+                    rep.supervisor._dump_postmortem("drain_timeout",
+                                                    str(exc))
+                raise exc
+            self.step()
+            ticks += 1
+        return [r for r in self.requests.values() if r.state == DONE]
+
+    def close(self) -> None:
+        for rep in self._alive():
+            rep.supervisor.close()
+
+    # -- health + rotation ---------------------------------------------------
+
+    def _update_health(self, rep: _Replica) -> None:
+        """Post-step health: a replica is healthy this tick iff its
+        supervisor ended cleanly RUNNING *and* consumed no restart inside
+        the tick (recovery is atomic within step(), so the restart counter
+        delta is how RECOVERING is observed). Unhealthy -> out of rotation
+        now; re-entry needs ``health_recover_ticks`` consecutive healthy
+        ticks — the hysteresis that keeps a crash-looping replica from
+        flapping back into rotation on every good tick."""
+        sup = rep.supervisor
+        healthy = (sup.state == RUNNING
+                   and sup.restarts == rep.last_restarts)
+        rep.last_restarts = sup.restarts
+        if not healthy:
+            if rep.in_rotation:
+                self._log_event("drain", rep)
+            rep.in_rotation = False
+            rep.healthy_streak = 0
+        else:
+            rep.healthy_streak += 1
+            if (not rep.in_rotation
+                    and rep.healthy_streak >= self.health_recover_ticks):
+                rep.in_rotation = True
+                self._log_event("re-enter", rep)
+        self._now = max(self._now, sup.engine._now)
+
+    # -- replica loss + migration -------------------------------------------
+
+    def _lose_replica(self, rep: _Replica, cause: str) -> None:
+        """A whole replica died. Host-death discipline: nothing of its
+        memory is trusted — the in-flight picture rebuilds from its
+        ON-DISK journal alone (every append was flushed before the
+        supervisor acted on it), live handles rewind to their journaled
+        prefixes, and survivors adopt the in-flight requests in rid order
+        so FCFS arrival order survives the loss."""
+        rep.alive = False
+        rep.in_rotation = False
+        self.replica_losses += 1
+        if self.metrics is not None:
+            self.metrics.on_replica_loss()
+        prev_now = rep.supervisor.engine._now
+        self._now = max(self._now, prev_now)
+        self._log_event("loss", rep)
+        try:
+            # release the dead handle; its buffered state was already
+            # flushed per append, so this adds nothing the disk lacked
+            rep.supervisor.journal.close()
+        except OSError:                      # pragma: no cover - env guard
+            pass
+        snapshots = recover_state(read_journal(rep.journal_path)[0])
+        inflight = []
+        for rid in sorted(snapshots):
+            h = self.requests.get(rid)
+            if h is None:
+                # the submission whose admission crash killed this replica:
+                # journaled, but the handle never made it back to the
+                # caller — the snapshot BECOMES the caller's handle
+                h = snapshots[rid]
+                self.requests[rid] = h
+            else:
+                ServeSupervisor._apply_snapshot(h, snapshots[rid])
+            if h.state == QUEUED:
+                inflight.append(h)
+        if self.trace is not None:
+            self.trace.on_crash(prev_now, [h.rid for h in inflight],
+                                "ReplicaLost")
+        targets = self._alive()
+        if not targets:
+            # the last replica died: the fleet immediately replaces it —
+            # in-flight work must never strand waiting for an autoscaler
+            targets = [self._spawn_replica(log="replace")]
+        adopted: dict[_Replica, int] = {}
+        for h in inflight:
+            cand = [r for r in targets if r.in_rotation] or targets
+            dst, hit = self.router.route(h.prompt, cand)
+            if hit and self.metrics is not None:
+                self.metrics.on_affinity_hit()
+            if self.trace is not None:
+                self.trace.on_migrate(h, prev_now, rep.idx, dst.idx)
+            dst.supervisor.adopt(h, on_token=self._user_cb.get(h.rid))
+            self._home[h.rid] = dst.idx
+            adopted[dst] = adopted.get(dst, 0) + 1
+        self.migrations += len(inflight)
+        if self.metrics is not None:
+            self.metrics.on_fleet_migrated(len(inflight))
+        # the per-replica restart timeline: every ADOPTING journal records
+        # the loss it absorbed (observability-only, like supervisor
+        # restart records — the report CLI renders these per journal)
+        for dst in sorted(adopted, key=lambda r: r.idx):
+            dst.supervisor.journal.log_restart(
+                self.replica_losses, False,
+                f"ReplicaLost(r{rep.idx})->adopted={adopted[dst]} "
+                f"[{cause}]", tick=self.tick)
+
+    # -- autoscaling ---------------------------------------------------------
+
+    def _autoscale_step(self) -> None:
+        pol = self.autoscale
+        # the floor binds on the loss side too: a replica-kill (or budget
+        # exhaustion) must not leave the fleet below min_replicas waiting
+        # for a backlog that light traffic may never build
+        while self.n_alive < pol.min_replicas:
+            self._spawn_replica(log="replace")
+        alive = self._alive()
+        qd = sum(r.supervisor.scheduler.queue_depth for r in alive)
+        kv_high = False
+        if pol.kv_frac_high is not None:
+            use = tot = 0
+            for r in alive:
+                stats = getattr(r.supervisor.pool, "stats", None)
+                if stats is not None:
+                    s = stats()
+                    use += s["blocks_in_use"]
+                    tot += s["blocks_total"]
+            kv_high = tot > 0 and use / tot >= pol.kv_frac_high
+        if qd >= pol.scale_out_queue_depth or kv_high:
+            self._backlog_ticks += 1
+        else:
+            self._backlog_ticks = 0
+        if (self._backlog_ticks >= pol.scale_out_ticks
+                and self.n_alive < pol.max_replicas):
+            self._spawn_replica(log="scale-out")
+            self._backlog_ticks = 0
+        self._retire_idle()
+
+    def _retire_idle(self) -> None:
+        """Drain-then-retire: a replica OBSERVED idle (nothing queued or
+        active — i.e. already drained) for ``retire_idle_s`` retires,
+        newest first, never below ``min_replicas``. Runs every fleet tick
+        AND at every timestamped submit, because an idle trough advances
+        time through arrivals, not busy ticks. Idleness is anchored at the
+        first idle OBSERVATION (``idle_since``), so the clock base —
+        virtual from 0, or absolute wall monotonic — cancels out."""
+        if self.autoscale is None:
+            return
+        pol = self.autoscale
+        for rep in sorted(self._alive(), key=lambda r: -r.idx):
+            if rep.supervisor.busy:
+                rep.idle_since = None
+                continue
+            if rep.idle_since is None:
+                rep.idle_since = self._now
+                continue
+            if self.n_alive <= pol.min_replicas:
+                continue
+            if self._now - rep.idle_since >= pol.retire_idle_s:
+                rep.alive = False
+                rep.in_rotation = False
+                rep.supervisor.close()
+                self._log_event("retire", rep)
+                if self.metrics is not None:
+                    self.metrics.on_retire()

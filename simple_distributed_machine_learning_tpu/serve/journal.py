@@ -21,6 +21,20 @@ line is written per token)::
     {"ev":"done","rid":3,"reason":"length","t":14.0}
     {"ev":"shed","rid":5,"reason":"deadline","t":14.2}
     {"ev":"restart","n":1,"degraded":false,"cause":"EngineCrash"}
+    {"ev":"snap","rid":3,"prompt":[...],"max_new":8,...,"state":"queued",
+     "reason":null,"toks":[17,4],"kd":[123,456],"dkd":null,"ftt":13.1,
+     "dt":null}
+
+A ``snap`` record is one request's ENTIRE recovered state in a single
+line — everything the per-event records would fold to. Two writers emit
+them: :meth:`RequestJournal.rotate` (compaction: the whole journal is
+rewritten as one snap per request, so a long-lived replica's cold restart
+stops re-reading the full token history) and cross-replica migration
+(``ServeSupervisor.adopt``: the adopting replica journals the migrated
+request's snapshot first, so ITS journal alone recovers the adoptee
+through any later crash). Ordinary ``tok``/``done``/``shed`` records keep
+folding on top of a ``snap``, so a rotated journal appends exactly like
+an unrotated one.
 
 Corruption tolerance mirrors ``CheckpointStore.latest_valid``: a crash can
 tear at most the tail, so :func:`read_journal` keeps the longest prefix of
@@ -78,6 +92,28 @@ def read_journal(path: str) -> tuple[list[dict], int]:
     return events, valid
 
 
+def _request_from(ev: dict) -> Request:
+    """The journaled request identity (submit and snap records share it):
+    one builder, so a field added to the journal grammar cannot silently
+    diverge between the fresh-submission fold and the rotation/migration
+    fold recovery is pinned byte-identical across."""
+    r = Request(
+        rid=int(ev["rid"]),
+        prompt=np.asarray(ev["prompt"], np.int32),
+        max_new_tokens=int(ev["max_new"]),
+        temperature=float(ev["temp"]),
+        top_k=ev["top_k"],
+        top_p=ev["top_p"],
+        eos_id=ev["eos"],
+        seed=int(ev["seed"]),
+        cls=ev["cls"],
+        priority=int(ev["prio"]),
+        ttft_deadline_s=ev["ttft_dl"],
+        deadline_s=ev["dl"])
+    r.submit_time = ev["t"]
+    return r
+
+
 def recover_state(events: list[dict]) -> dict[int, Request]:
     """Fold journal events into per-request snapshots, keyed by rid.
 
@@ -94,20 +130,7 @@ def recover_state(events: list[dict]) -> dict[int, Request]:
     for ev in events:
         kind = ev["ev"]
         if kind == "submit":
-            r = Request(
-                rid=int(ev["rid"]),
-                prompt=np.asarray(ev["prompt"], np.int32),
-                max_new_tokens=int(ev["max_new"]),
-                temperature=float(ev["temp"]),
-                top_k=ev["top_k"],
-                top_p=ev["top_p"],
-                eos_id=ev["eos"],
-                seed=int(ev["seed"]),
-                cls=ev["cls"],
-                priority=int(ev["prio"]),
-                ttft_deadline_s=ev["ttft_dl"],
-                deadline_s=ev["dl"])
-            r.submit_time = ev["t"]
+            r = _request_from(ev)
             reqs[r.rid] = r
         elif kind == "tok":
             r = reqs[int(ev["rid"])]
@@ -127,6 +150,21 @@ def recover_state(events: list[dict]) -> dict[int, Request]:
             r.state = SHED
             r.finish_reason = ev["reason"]
             r.done_time = ev.get("t")
+        elif kind == "snap":
+            # one request's whole folded state (rotation / migration):
+            # REPLACES any earlier state for the rid — the writer already
+            # folded everything the replaced records said
+            r = _request_from(ev)
+            r.state = ev["state"]
+            r.finish_reason = ev["reason"]
+            r.tokens[:] = [int(t) for t in ev["toks"]]
+            if ev["kd"] is not None:
+                r.key_data = np.asarray(ev["kd"], np.uint32)
+            if ev.get("dkd") is not None:
+                r.draft_key_data = np.asarray(ev["dkd"], np.uint32)
+            r.first_token_time = ev["ftt"]
+            r.done_time = ev["dt"]
+            reqs[r.rid] = r
         # "restart" records are observability only
     for r in reqs.values():
         if r.state == QUEUED and r.tokens:
@@ -238,6 +276,61 @@ class RequestJournal:
         self.append({"ev": "restart", "n": int(n),
                      "degraded": bool(degraded), "cause": cause,
                      **self._tick_field(tick)})
+
+    def log_snapshot(self, request: Request, tick=None) -> None:
+        """One request's ENTIRE state as a single ``snap`` record (module
+        docstring grammar) — what :meth:`rotate` compacts to and what
+        cross-replica migration writes into the adopting replica's
+        journal so it alone can recover the adoptee."""
+        kd, dkd = request.key_data, request.draft_key_data
+        self.append({
+            "ev": "snap", "rid": request.rid,
+            "prompt": [int(x) for x in np.asarray(request.prompt)],
+            "max_new": int(request.max_new_tokens),
+            "temp": float(request.temperature),
+            "top_k": request.top_k, "top_p": request.top_p,
+            "eos": request.eos_id, "seed": int(request.seed),
+            "cls": request.cls, "prio": int(request.priority),
+            "ttft_dl": request.ttft_deadline_s, "dl": request.deadline_s,
+            "t": request.submit_time, "state": request.state,
+            "reason": request.finish_reason,
+            "toks": [int(t) for t in request.tokens],
+            "kd": None if kd is None else [int(x) for x in np.asarray(kd)],
+            "dkd": (None if dkd is None
+                    else [int(x) for x in np.asarray(dkd)]),
+            "ftt": request.first_token_time, "dt": request.done_time,
+            **self._tick_field(tick)})
+
+    def rotate(self, tick=None) -> int:
+        """Compact the journal in place: fold everything durable into
+        per-request snapshots and rewrite the file as ONE ``snap`` record
+        per rid (rid order), atomically (write-then-rename, the checkpoint
+        store's discipline — a crash mid-rotation leaves either the old
+        journal or the new one, never a hybrid). Returns bytes reclaimed.
+
+        The pinned contract (tests/test_fleet.py): ``recover_state`` over
+        the rotated journal yields byte-identical snapshots to recovery
+        from the unrotated one — rotation changes the replay COST of a
+        cold restart (no more re-reading the full token history), never
+        its result. Restart records are observability-only and dropped."""
+        self._f.flush()
+        events, old_bytes = read_journal(self.path)
+        snaps = recover_state(events)
+        tmp = self.path + ".rotate"
+        writer = RequestJournal.__new__(RequestJournal)
+        writer.path, writer.sync, writer.bytes = tmp, self.sync, 0
+        writer._recovered_events = []
+        writer._f = open(tmp, "wb")
+        try:
+            for rid in sorted(snaps):
+                writer.log_snapshot(snaps[rid], tick=tick)
+        finally:
+            writer.close()
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self.bytes = os.path.getsize(self.path)
+        return old_bytes - self.bytes
 
     def tail(self, n: int = 64) -> list[dict]:
         """The last ``n`` valid journal events, re-read from disk — the

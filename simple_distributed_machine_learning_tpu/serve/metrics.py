@@ -63,7 +63,28 @@ Crash-restart + overload-control instruments (fed by the serve supervisor,
 - ``serve_degraded`` (gauge, 0/1) — whether the supervisor is in a
   degraded mode (fallback engine layout after repeated crashes, or the
   overload best-effort lockout);
-- ``serve_journal_bytes`` (gauge) — the request journal's durable size.
+- ``serve_journal_bytes`` (gauge) — the request journal's durable size
+  (under a fleet: summed over every alive replica's journal).
+
+Fleet instruments (fed by the multi-replica fleet, ``serve/fleet.py``):
+
+- ``serve_fleet_replicas`` (gauge) — alive replicas currently IN ROTATION
+  (healthy per the supervisor state machine and past the re-entry
+  hysteresis): the capacity the router is actually spreading load over;
+- ``serve_fleet_replica_losses_total`` (counter) — whole-replica deaths
+  the fleet absorbed (injected ``replica-kill`` faults and replicas whose
+  supervisor exhausted its restart budget);
+- ``serve_fleet_migrations_total`` (counter) — in-flight requests
+  re-admitted onto a SURVIVING replica from a dead replica's journal
+  alone (the cross-replica migration path — each one's token stream stays
+  bit-exact vs the uninterrupted run);
+- ``serve_route_affinity_hits_total`` (counter) — routing decisions that
+  landed on a replica already holding the request's prompt prefix in its
+  paged pool's registry (the prefix-cache-aware half of the router; the
+  hot-prefix-skew scenario pins this strictly above round-robin);
+- ``serve_fleet_scale_outs_total`` / ``serve_fleet_retired_total``
+  (counters) — autoscaler actions: replicas added on sustained backlog,
+  replicas drained-then-retired on sustained idleness.
 
 Model-drift instruments (ISSUE 12 — the PR-8 static model checked as a
 runtime invariant, fed every tick from ``engine.kv_drift``):
@@ -160,6 +181,16 @@ class ServeMetrics:
         self.journal_bytes_gauge = r.gauge("serve_journal_bytes")
         self._shed_reasons: dict[str, object] = {}
         self._resilience_seen = False
+        # fleet instruments (serve/fleet.py; the summary's fleet block
+        # appears once the fleet sets its replica gauge)
+        self.fleet_replicas = r.gauge("serve_fleet_replicas")
+        self.fleet_losses = r.counter("serve_fleet_replica_losses_total")
+        self.fleet_migrations = r.counter("serve_fleet_migrations_total")
+        self.route_affinity_hits = r.counter(
+            "serve_route_affinity_hits_total")
+        self.fleet_scale_outs = r.counter("serve_fleet_scale_outs_total")
+        self.fleet_retired = r.counter("serve_fleet_retired_total")
+        self._fleet_seen = False
         self._classes: set[str] = set()
         if outdir:
             os.makedirs(outdir, exist_ok=True)
@@ -229,6 +260,35 @@ class ServeMetrics:
     def set_journal_bytes(self, n: int) -> None:
         self._resilience_seen = True
         self.journal_bytes_gauge.set(int(n))
+
+    # -- fleet hooks (serve/fleet.py) ---------------------------------------
+
+    def set_fleet_replicas(self, n: int) -> None:
+        """Alive in-rotation replicas after this fleet tick."""
+        self._fleet_seen = True
+        self.fleet_replicas.set(int(n))
+
+    def on_replica_loss(self) -> None:
+        self._fleet_seen = True
+        self.fleet_losses.inc()
+
+    def on_fleet_migrated(self, n: int) -> None:
+        """``n`` in-flight requests migrated off a dead replica."""
+        self._fleet_seen = True
+        if n:
+            self.fleet_migrations.inc(n)
+
+    def on_affinity_hit(self) -> None:
+        self._fleet_seen = True
+        self.route_affinity_hits.inc()
+
+    def on_scale_out(self) -> None:
+        self._fleet_seen = True
+        self.fleet_scale_outs.inc()
+
+    def on_retire(self) -> None:
+        self._fleet_seen = True
+        self.fleet_retired.inc()
 
     def _on_any_token(self) -> None:
         self.tokens.inc()
@@ -390,6 +450,15 @@ class ServeMetrics:
                 "shed_by_reason": shed,
                 "degraded": int(self.degraded_gauge.value),
                 "journal_bytes": int(self.journal_bytes_gauge.value),
+            })
+        if self._fleet_seen:
+            out.update({
+                "fleet_replicas": int(self.fleet_replicas.value),
+                "fleet_replica_losses": int(self.fleet_losses.value),
+                "fleet_migrations": int(self.fleet_migrations.value),
+                "route_affinity_hits": int(self.route_affinity_hits.value),
+                "fleet_scale_outs": int(self.fleet_scale_outs.value),
+                "fleet_retired": int(self.fleet_retired.value),
             })
         if self._drift_seen:
             out["kv_bytes_predicted"] = int(self.kv_bytes_predicted.value)

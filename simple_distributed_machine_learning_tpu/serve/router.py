@@ -1,0 +1,93 @@
+"""The fleet router: which replica should serve this request?
+
+One :class:`FleetRouter` sits in front of the fleet's replicas
+(``serve/fleet.py``) and answers exactly one question per submission —
+*which in-rotation replica gets it* — from two signals the serving stack
+already maintains:
+
+- **Prefix-cache affinity** (policy ``"affinity"``, the default): the
+  paged pool's prefix registry (``serve/slots.py::PagedKVPool``) is probed
+  per replica via ``pool.shared_prefix_len(prompt)`` — a pure read, no
+  referencing, no memo — and the request routes to the replica already
+  holding the LONGEST registered prefix of its prompt. That is the
+  system-prompt case at fleet scale: the first request pays the prefix's
+  prefill once on one replica, and every later request with the same
+  prefix lands where the blocks already live instead of recomputing them
+  on a cold replica (the hot-prefix-skew scenario pins affinity strictly
+  above round-robin on the prefix-hit counters). Ties — including the
+  no-registered-prefix cold start — fall back to least-loaded.
+- **Least-loaded fallback** (policy ``"least-loaded"``): order replicas by
+  ``(queue_depth, occupancy, idx)`` — the same quantities the PR-4
+  registry gauges (``serve_queue_depth`` / ``serve_slots_active``) report
+  — and take the minimum. Deterministic: the index breaks exact ties, so
+  a virtual-clock scenario routes identically on every run.
+- **Round-robin** (policy ``"round-robin"``): cycle over the in-rotation
+  replicas in index order — the affinity-blind baseline the scenario
+  suite compares against.
+
+The router never inspects health itself: the FLEET decides which replicas
+are in rotation (supervisor state machine + re-entry hysteresis,
+``serve/fleet.py``) and hands the candidate list in. An empty candidate
+list is the caller's bug — the fleet always routes over at least one
+alive replica (spawning one if the last died).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+POLICIES = ("affinity", "least-loaded", "round-robin")
+
+
+class FleetRouter:
+    """Routing policy over fleet replicas; see module docstring.
+
+    ``route(prompt, candidates)`` returns ``(replica, affinity_hit)``
+    where ``affinity_hit`` is True iff the decision was made by a strictly
+    positive prefix-registry match (the ``serve_route_affinity_hits_total``
+    increment). Candidates are fleet replica records duck-typing
+    ``.idx`` and ``.supervisor`` (engine surface: ``scheduler``/``pool``).
+    """
+
+    def __init__(self, policy: str = "affinity") -> None:
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown route policy {policy!r}; known: {POLICIES}")
+        self.policy = policy
+        self._rr = 0          # round-robin cursor (monotonic, mod applied)
+
+    @staticmethod
+    def _load_key(rep) -> tuple:
+        """Least-loaded ordering: queue depth first (the backlog a new
+        request would sit behind), then slot occupancy (how full the
+        continuous batch runs), then the index as the deterministic
+        tiebreak."""
+        sup = rep.supervisor
+        pool = sup.pool
+        return (sup.scheduler.queue_depth,
+                pool.n_active / pool.n_slots,
+                rep.idx)
+
+    def route(self, prompt, candidates: list) -> tuple:
+        """Pick the replica for ``prompt`` from ``candidates`` (the
+        fleet's in-rotation list, index order, non-empty)."""
+        if not candidates:
+            raise ValueError("route over an empty candidate list — the "
+                             "fleet must always offer at least one "
+                             "alive replica")
+        if self.policy == "round-robin":
+            rep = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            return rep, False
+        if self.policy == "affinity":
+            prompt = np.asarray(prompt, np.int32)
+            best, best_len = None, 0
+            for rep in candidates:
+                n = rep.supervisor.pool.shared_prefix_len(prompt)
+                if n > best_len:
+                    best, best_len = rep, n
+            if best is not None:
+                return best, True
+        # least-loaded: the standalone policy AND the affinity cold-start
+        # fallback
+        return min(candidates, key=self._load_key), False

@@ -168,6 +168,14 @@ class _SlotPoolBase:
         """Release the slot's sequence state at retirement (before the slot
         itself frees). Dense layout: nothing to do."""
 
+    # -- routing affinity (FleetRouter's signal) -----------------------------
+
+    def shared_prefix_len(self, prompt) -> int:
+        """Prompt positions this pool could serve from already-registered
+        prefix blocks — the fleet router's affinity signal
+        (``serve/router.py``). The dense layout shares nothing: 0."""
+        return 0
+
     # -- preemption feasibility (PriorityScheduler's precheck) --------------
 
     def admit_shortfall(self, request) -> int:
@@ -525,6 +533,13 @@ class PagedKVPool(_SlotPoolBase):
                 self._free_blocks.append(block)
 
     # -- prefix registry ---------------------------------------------------
+
+    def shared_prefix_len(self, prompt) -> int:
+        """The paged affinity signal: longest registered prefix of
+        ``prompt`` (in positions) this pool already holds. A pure probe —
+        no referencing, no memo, no registry mutation — so the router may
+        ask every replica without perturbing any pool."""
+        return self._probe_prefix(np.asarray(prompt, np.int32))[0]
 
     def _probe_cached(self, request) -> tuple[int, list[tuple[int, int]]]:
         """Probe memoized on the request, keyed by the registry epoch AND

@@ -115,6 +115,19 @@ class OverloadPolicy:
     degraded_priority_floor: int = 0
 
     def __post_init__(self):
+        if self.class_rates is not None:
+            # defensive copy, normalized to plain tuples: ONE policy
+            # instance is routinely shared by N supervisors (the fleet's
+            # replica factory), so the stored mapping must not alias a
+            # caller dict whose later mutation would silently retune — or
+            # couple — every replica's admission control. Each supervisor
+            # still keeps its own PER-INSTANCE bucket fills (_buckets);
+            # tests/test_fleet.py pins that one replica's debit never
+            # appears in another's.
+            object.__setattr__(
+                self, "class_rates",
+                {cls: (float(rb[0]), float(rb[1]))
+                 for cls, rb in self.class_rates.items()})
         if self.max_queue_depth is not None and self.max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1, got "
                              f"{self.max_queue_depth}")
@@ -191,7 +204,8 @@ class ServeSupervisor:
                  default_ttft_deadline_s: float | None = None,
                  default_deadline_s: float | None = None,
                  trace=None, flight=None, postmortem_dir: str | None = None,
-                 postmortem_tail: int = 64, shed_burst: int = 4) -> None:
+                 postmortem_tail: int = 64, shed_burst: int = 4,
+                 postmortem_tag: str = "") -> None:
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, got "
                              f"{max_restarts}")
@@ -219,6 +233,10 @@ class ServeSupervisor:
         self.trace = trace
         self.postmortem_dir = postmortem_dir
         self.postmortem_tail = int(postmortem_tail)
+        # bundle filename infix — the FLEET sets "-r<idx>" per replica so
+        # N supervisors sharing one postmortem_dir never overwrite each
+        # other's postmortem-000-* names
+        self.postmortem_tag = postmortem_tag
         self.shed_burst = int(shed_burst)
         if flight is None and postmortem_dir is not None:
             from simple_distributed_machine_learning_tpu.serve.flight import (
@@ -399,7 +417,8 @@ class ServeSupervisor:
         )
         path = os.path.join(
             self.postmortem_dir,
-            f"{BUNDLE_PREFIX}-{len(self.postmortems):03d}-{trigger}.json")
+            f"{BUNDLE_PREFIX}{self.postmortem_tag}"
+            f"-{len(self.postmortems):03d}-{trigger}.json")
         write_bundle(
             path, trigger=trigger, cause=cause, tick=self.tick,
             flight=self.flight, requests=self.requests,
@@ -548,6 +567,44 @@ class ServeSupervisor:
             self.trace.on_submit(r, now)
             self.trace.on_shed(r, now, reason)
         return r
+
+    # -- cross-replica migration (serve/fleet.py) ----------------------------
+
+    def adopt(self, request: Request, on_token=None) -> Request:
+        """Adopt a request migrated from ANOTHER replica whose host died.
+
+        The full snapshot is journaled here FIRST (one ``snap`` record,
+        ``journal.py::log_snapshot``) so THIS replica's journal alone
+        recovers the adoptee — a later crash of this replica, or a second
+        replica loss on top of the first, replays it exactly like a native
+        submission. An in-flight snapshot then re-admits through
+        ``engine.restore`` (the same preempt/resume path crash recovery
+        uses, so the continued decode stays bit-exact); a DONE/SHED
+        snapshot is adopted as a readable handle only. ``on_token`` is the
+        CALLER's streaming callback (the dead replica's wiring died with
+        it)."""
+        if request.rid in self.requests:
+            raise ValueError(
+                f"request {request.rid} already lives in this replica — "
+                f"adopt() is for migrated rids, which are fleet-unique")
+        if request.state not in (QUEUED, DONE, SHED):
+            raise ValueError(
+                f"request {request.rid} is {request.state!r} — migration "
+                f"adopts journal snapshots (queued/done/shed), never a "
+                f"live engine's state")
+        self.journal.log_snapshot(request, tick=self.tick)
+        self.requests[request.rid] = request
+        if request.state == QUEUED:
+            request.on_token = self._on_token
+            self._user_cb[request.rid] = on_token
+            self.engine.restore(request)
+            self._open.add(request.rid)
+        else:
+            # finished exactly at the loss boundary: keep the rid space
+            # clear of it (restore() was never called to bump it)
+            self.engine._next_rid = max(self.engine._next_rid,
+                                        request.rid + 1)
+        return request
 
     # -- crash recovery -----------------------------------------------------
 

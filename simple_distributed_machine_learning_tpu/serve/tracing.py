@@ -210,6 +210,19 @@ class ServeTrace:
                                   cause=cause)
         self._row("restart", None, t, n=n, degraded=degraded, cause=cause)
 
+    def on_migrate(self, r, t: float, src: int, dst: int) -> None:
+        """Cross-replica migration (``serve/fleet.py``): ``r`` left dead
+        replica ``src`` and is being adopted by replica ``dst``. The rid
+        is fleet-unique, so the same recorder — attached to EVERY
+        replica's supervisor — joins the request's spans across replicas
+        exactly as it joins them across one supervisor's restarts; the
+        adopting engine's ``restore`` fires ``on_readmit`` right after
+        this row."""
+        self.tracer.async_instant("migrate", r.rid, ts_us=t * 1e6,
+                                  cat="req", src=src, dst=dst)
+        self._row("migrate", r.rid, t, src=src, dst=dst,
+                  tokens=len(r.tokens))
+
     def on_readmit(self, r, t: float) -> None:
         """Journal recovery re-enqueued ``r`` into the rebuilt engine. On a
         cold restart this recorder never saw the submit, so the request

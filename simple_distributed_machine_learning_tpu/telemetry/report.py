@@ -151,6 +151,14 @@ def render(report: dict) -> str:
                 f"{s.get('recovered_requests', 0)} recovered, "
                 f"{s.get('shed_total', 0)} shed {s.get('shed_by_reason', {})}"
                 f", degraded={s.get('degraded', 0)}")
+        if "fleet_replicas" in s:
+            lines.append(
+                f"  fleet: {s['fleet_replicas']} replica(s) in rotation, "
+                f"{s.get('fleet_replica_losses', 0)} loss(es), "
+                f"{s.get('fleet_migrations', 0)} migration(s), "
+                f"{s.get('route_affinity_hits', 0)} affinity hit(s), "
+                f"{s.get('fleet_scale_outs', 0)} scale-out(s), "
+                f"{s.get('fleet_retired', 0)} retired")
         if "kv_drift_bytes" in s:
             ok = "OK" if s["kv_drift_bytes"] == 0 else "NONZERO"
             lines.append(
@@ -171,6 +179,16 @@ def render(report: dict) -> str:
             f"{scen.get('shed', 0)} shed"
             + (f", {scen['restarts']} restart(s)"
                if "restarts" in scen else ""))
+        fl = scen.get("fleet")
+        if fl:
+            lines.append(
+                f"    fleet: {fl.get('replicas')} replica(s) "
+                f"(route {fl.get('route')}), "
+                f"{fl.get('replica_losses', 0)} loss(es), "
+                f"{fl.get('migrations', 0)} migration(s), "
+                f"{fl.get('affinity_hits', 0)} affinity hit(s), "
+                f"{fl.get('scale_outs', 0)} scale-out(s), "
+                f"{fl.get('retired', 0)} retired")
         for cls, att in sorted((scen.get("slo") or {}).items()):
             gates = [f"{k.split('_')[0]} {_fmt(att[k])}"
                      for k in ("ttft_attainment", "tpot_attainment")

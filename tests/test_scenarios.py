@@ -302,7 +302,8 @@ def test_unknown_scenario_rejected():
         run_scenario("nope", stages, CFG)
     assert set(SCENARIOS) == {"steady", "burst-interactive", "multi-tenant",
                               "burst-slow-tick", "crash-serve",
-                              "overload-shed"}
+                              "overload-shed", "fleet-replica-loss",
+                              "hot-prefix-skew", "fleet-autoscale-diurnal"}
 
 
 # ---------------------------------------------------------------------------
