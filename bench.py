@@ -1190,6 +1190,107 @@ def _measure_fleet_availability(stages, cfg, n_requests: int, max_new: int,
     }]
 
 
+def _measure_sentinel(n_steps: int = 48, fault_step: int = 30,
+                      snapshot_every: int = 4) -> list:
+    """Self-healing training cost and recovery (``resilience/sentinel.py``).
+
+    Two rows from the same small MLP workload:
+
+    - ``train_sentinel_overhead``: steady steps/sec with the sentinel OFF
+      vs ON (no faults) — the price of the per-step host sync + the
+      every-K-steps snapshot gather.
+    - ``train_sentinel_recovery``: an injected ``nan-grad`` at a fixed
+      step; the row pins recovered == True (run completes, >= 1 rollback,
+      the fault actually fired — the anti-vacuous gate) and reports the
+      replayed-step budget (at most ``snapshot_every - 1`` by
+      construction), quarantined batches and the ring's resident bytes.
+    """
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.data.mnist import Dataset
+    from simple_distributed_machine_learning_tpu.models.mlp import (
+        make_mlp_stages,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from simple_distributed_machine_learning_tpu.parallel.pipeline import (
+        Pipeline,
+    )
+    from simple_distributed_machine_learning_tpu.resilience import faults
+    from simple_distributed_machine_learning_tpu.train.trainer import (
+        TrainConfig,
+        Trainer,
+    )
+
+    rng = np.random.default_rng(0)
+    batch, n_batches = 64, 12
+    ds = Dataset(rng.standard_normal((batch * n_batches, 64),
+                                     dtype=np.float32),
+                 rng.integers(0, 10, batch * n_batches).astype(np.int32))
+    epochs = max(1, n_steps // n_batches)
+
+    def run(sentinel: bool, plan: str | None = None):
+        stages, wd, od = make_mlp_stages(jax.random.key(0),
+                                         [64, 128, 64, 10], 1)
+        pipe = Pipeline(stages, make_mesh(n_stages=1, n_data=1,
+                                          devices=jax.devices()[:1]),
+                        wd, od)
+        cfg = TrainConfig(epochs=epochs, batch_size=batch,
+                          print_throughput=False, sentinel=sentinel,
+                          sentinel_snapshot_every=snapshot_every)
+        tr = Trainer(pipe, ds, ds, cfg)
+        tr._print = lambda msg: None     # keep bench stdout row-clean
+        installed = (faults.install(faults.FaultPlan.parse(plan))
+                     if plan else None)
+        t0 = _time.perf_counter()
+        try:
+            tr.fit()
+        finally:
+            # only uninstall what THIS run installed: a bare baseline run
+            # must not clobber the SDML_CHAOS env plan main() installed
+            # for the wedged-probe drill
+            if installed is not None:
+                faults.uninstall()
+        wall = _time.perf_counter() - t0
+        fired = installed.stats()["total_fired"] if installed else 0
+        return tr, wall, fired
+
+    _, wall_off, _ = run(sentinel=False)
+    tr_on, wall_on, _ = run(sentinel=True)
+    steps = epochs * n_batches
+    tr_rec, _, fired = run(sentinel=True,
+                           plan=f"nan-grad@train.grad={fault_step}")
+    stats = tr_rec.sentinel_stats()
+    return [{
+        "config": "train_sentinel_overhead",
+        "steps": steps,
+        "steps_per_sec_off": round(steps / wall_off, 2),
+        "steps_per_sec_on": round(steps / wall_on, 2),
+        "overhead_frac": round(max(0.0, 1.0 - wall_off / wall_on), 4),
+        "snapshot_every": snapshot_every,
+        "ring_bytes": tr_on.sentinel_stats()["snapshot_ring_bytes"],
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }, {
+        "config": "train_sentinel_recovery",
+        "fault": f"nan-grad@train.grad={fault_step}",
+        "faults_fired": fired,
+        "anomalies": stats["anomalies"],
+        "rollbacks": stats["rollbacks"],
+        "quarantined_batches": stats["quarantined_batches"],
+        # replay budget: rollback lands on the newest pre-anomaly snapshot
+        "max_replayed_steps": snapshot_every - 1,
+        "recovered": bool(fired >= 1 and stats["rollbacks"] >= 1
+                          and not tr_rec.preempted),
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }]
+
+
 def _measure_jax_cpu_baseline() -> float:
     """Our own pipeline on 2 virtual CPU devices (BASELINE config 1 analog)."""
     code = (
@@ -1300,6 +1401,12 @@ def main() -> None:
                          "ring = latency-hiding ppermute-chunked collective "
                          "matmuls (parallel/overlap.py); pair with --tp; "
                          "experiment rows only, like --opt")
+    ap.add_argument("--sentinel", action="store_true",
+                    help="self-healing training rows (resilience/"
+                         "sentinel.py): sentinel on/off steps-per-sec "
+                         "overhead plus a nan-grad recovery drill "
+                         "(rollback + quarantine, anti-vacuous "
+                         "faults_fired gate)")
     ap.add_argument("--lint", action="store_true",
                     help="static-analysis preflight (analysis/): lint the "
                          "exact scanned step of every row before timing it "
@@ -1371,7 +1478,8 @@ def main() -> None:
     elif args.config is not None:
         names = [args.config]
     else:
-        names = [] if (args.decode or args.serve) else ["mlp2"]
+        names = [] if (args.decode or args.serve or args.sentinel) \
+            else ["mlp2"]
     # rc-17-aware preflight (SDML_CHAOS can inject wedged-device faults):
     # retry once with backoff; on persistent wedge the structured
     # device_unhealthy row IS this round's measurement — exit 0, no hang
@@ -1413,6 +1521,11 @@ def main() -> None:
 
     if args.decode and not args.all:
         _run_decode()
+    if args.sentinel:
+        for srow in _measure_sentinel():
+            print(json.dumps({"metric": srow.pop("config"), **srow}))
+        if not names and not args.serve:
+            return
     if args.serve:
         for srow in measure_serving(lint=args.lint):
             line = {"metric": srow["config"], "n_slots": srow["n_slots"]}

@@ -315,6 +315,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "the --chaos smoke use to keep multi-epoch runs "
                         "cheap without collapsing them to one epoch like "
                         "--dryrun does")
+    g.add_argument('--sentinel', action='store_true',
+                   help="self-healing training (resilience/sentinel.py): "
+                        "check every step's loss/grad-norm for NaN/Inf and "
+                        "EWMA loss spikes, keep a bounded in-memory ring of "
+                        "host snapshots, and on an anomaly roll back to the "
+                        "newest pre-anomaly snapshot, quarantine the "
+                        "offending batch (appended to quarantine.jsonl "
+                        "under --checkpoint-dir and deterministically "
+                        "skipped from then on) and replay forward — "
+                        "bit-exact vs a run that never saw the fault. "
+                        "Repeated anomalies escalate to the --chaos elastic "
+                        "supervisor (full disk restore). Also arms the "
+                        "numeric fault sites nan-grad@train.grad, "
+                        "corrupt-batch@data.batch, loss-spike@train.step "
+                        "for --chaos drills")
+    g.add_argument('--sentinel-window', type=int, default=16, metavar='W',
+                   help="with --sentinel: EWMA horizon for the loss-spike "
+                        "detector AND the escalation window (more than "
+                        "ring-size anomalies within W steps raise to the "
+                        "supervisor)")
+    g.add_argument('--sentinel-snapshot-every', type=int, default=4,
+                   metavar='K',
+                   help="with --sentinel: steps between in-memory snapshot-"
+                        "ring entries (rollback replays at most K-1 steps; "
+                        "smaller K = cheaper recovery, more frequent host "
+                        "gathers)")
     g.add_argument('--chaos', type=str, default=None, metavar='SPEC',
                    help="resilience drill (resilience/): train under a "
                         "deterministic fault-injection schedule with the "
@@ -465,6 +491,12 @@ def _dispatch(args) -> None:
     if args.max_steps_per_epoch is not None and args.max_steps_per_epoch < 1:
         raise SystemExit(f"--max-steps-per-epoch must be >= 1, got "
                          f"{args.max_steps_per_epoch}")
+    if args.sentinel_window < 2:
+        raise SystemExit(f"--sentinel-window must be >= 2, got "
+                         f"{args.sentinel_window}")
+    if args.sentinel_snapshot_every < 1:
+        raise SystemExit(f"--sentinel-snapshot-every must be >= 1, got "
+                         f"{args.sentinel_snapshot_every}")
     if args.scenario is not None:
         _run_scenario(args, n_stages, key)
         return
@@ -557,7 +589,10 @@ def _train_config(args):
         resume=not args.no_resume, zero1=args.zero1,
         async_checkpoint=args.async_checkpoint,
         shuffle=args.shuffle,
-        metrics_json=args.metrics_json)
+        metrics_json=args.metrics_json,
+        sentinel=args.sentinel,
+        sentinel_window=args.sentinel_window,
+        sentinel_snapshot_every=args.sentinel_snapshot_every)
 
 
 def _telemetry(args):
@@ -631,12 +666,48 @@ def _fit(args, trainer) -> None:
         if trainer.telemetry is not None:
             trainer.telemetry.close()    # eval spans -> trace.json
         return
-    if args.profile:
-        from simple_distributed_machine_learning_tpu.utils.profiler import trace
-        with trace(args.profile):
+    # graceful preemption: SIGTERM/SIGINT finish the in-flight step, write
+    # a synchronous checkpoint carrying the mid-epoch data cursor, flush
+    # the quarantine journal + telemetry and exit 0 — the training mirror
+    # of the --serve-sim handler (a rollout must not look like a fault)
+    import signal
+
+    def _on_signal(signum, frame):
+        trainer.request_stop(signum)
+
+    old_handlers = {}
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            old_handlers[s] = signal.signal(s, _on_signal)
+    except ValueError:
+        old_handlers = {}              # not the main thread: no handlers
+    try:
+        if args.profile:
+            from simple_distributed_machine_learning_tpu.utils.profiler import (
+                trace,
+            )
+            with trace(args.profile):
+                trainer.fit()
+        else:
             trainer.fit()
-    else:
-        trainer.fit()
+    finally:
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+    stats = trainer.sentinel_stats()
+    if stats is not None:
+        trainer._print(
+            f"| sentinel: absorbed {stats['anomalies']} anomal"
+            f"{'y' if stats['anomalies'] == 1 else 'ies'} "
+            f"({stats['rollbacks']} rollback(s), "
+            f"{stats['quarantined_batches']} quarantined batch(es), "
+            f"ring {stats['snapshot_ring_bytes']} bytes)")
+    if trainer.preempted:
+        trainer._print(
+            "| train: graceful shutdown complete — "
+            + ("resume with the same --checkpoint-dir to continue "
+               "bit-exact" if trainer.preempt_persisted
+               else "no --checkpoint-dir was configured, so the "
+               "interrupted progress was NOT persisted"))
 
 
 def _run_gpt(args, n_stages: int, key) -> None:
@@ -1216,6 +1287,19 @@ def _run_chaos(args, n_stages: int, key) -> None:
         plan = faults.FaultPlan.parse(args.chaos)
     except ValueError as e:
         raise SystemExit(f"bad --chaos spec: {e}") from None
+    from simple_distributed_machine_learning_tpu.resilience.faults import (
+        SENTINEL_KINDS,
+    )
+    numeric = sorted({s.kind for s in plan.specs
+                      if s.kind in SENTINEL_KINDS})
+    if numeric and not args.sentinel:
+        # without the sentinel a numeric fault's standard effect is a
+        # raised NumericFault the supervisor treats as a real bug — the
+        # drill would fail confusingly instead of being absorbed
+        raise SystemExit(
+            f"--chaos plan contains sentinel-interpreted kinds "
+            f"({', '.join(numeric)}): add --sentinel so the trainer "
+            f"absorbs them")
     if args.chaos_stages:
         try:
             topologies = [int(s) for s in args.chaos_stages.split(",")]
@@ -1307,6 +1391,27 @@ def _run_chaos(args, n_stages: int, key) -> None:
                         f"{'(' + a['fault'] + ')' if 'fault' in a else ''}"
                         for a in report["attempts"])
           + f"; faults fired: {plan.stats()['total_fired']}")
+    if args.sentinel:
+        tot = {"anomalies": 0, "rollbacks": 0}
+        quarantined = 0
+        for a in report["attempts"]:
+            s = a.get("sentinel") or {}
+            tot["anomalies"] += s.get("anomalies", 0)
+            tot["rollbacks"] += s.get("rollbacks", 0)
+            # the journal is cumulative across attempts (loaded from disk):
+            # the last attempt's count is the total
+            quarantined = s.get("quarantined_batches", quarantined)
+        print(f"| chaos: sentinel absorbed {tot['anomalies']} anomal"
+              f"{'y' if tot['anomalies'] == 1 else 'ies'} "
+              f"({tot['rollbacks']} rollback(s), {quarantined} "
+              f"quarantined batch(es))")
+    if plan.stats()["total_fired"] == 0:
+        # the min_anomalies-style anti-vacuous gate: a chaos drill whose
+        # schedule never fired proves nothing — fail it instead of letting
+        # a typo'd step number pass green
+        raise SystemExit(
+            "--chaos plan never fired (scheduled step beyond the run?) — "
+            "the drill is vacuous; fix the schedule")
 
 
 def _print_sample(args, trainer, cfg, test_ds) -> None:

@@ -9,6 +9,11 @@ repack, the seeded traffic simulator) into one tested capability:
   frozen-peer, slow-tick, checkpoint-write-crash, wedged-device) injected
   at named sites threaded through the Trainer, the checkpoint writer, the
   watchdog, the serving engine and the bench probe (``--chaos``);
+- :mod:`.sentinel` — self-healing training: per-step NaN/Inf + EWMA-spike
+  anomaly detection, a bounded in-memory snapshot ring for micro-rollback
+  (no disk restore for a transient numeric fault), a deterministic
+  corrupt-batch quarantine journal, and escalation to the elastic
+  supervisor when anomalies repeat (``--sentinel``);
 - :mod:`.store` — checksum-validated checkpoint history with a manifest:
   restore picks the latest checkpoint that VERIFIES, never a corrupt one;
 - :mod:`.supervisor` — the elastic checkpoint-restart loop: on a
@@ -37,7 +42,14 @@ _EXPORTS = {
     "CheckpointWriteCrash": ".faults",
     "EngineCrash": ".faults",
     "ReplicaLost": ".faults",
+    "NumericFault": ".faults",
+    "Preempted": ".faults",
     "CheckpointStore": ".store",
+    "Sentinel": ".sentinel",
+    "SentinelConfig": ".sentinel",
+    "SentinelExhausted": ".sentinel",
+    "QuarantineJournal": ".sentinel",
+    "SnapshotRing": ".sentinel",
     "ElasticTrainer": ".supervisor",
     "PeerLost": ".supervisor",
     "RestartBudgetExceeded": ".supervisor",
@@ -50,7 +62,8 @@ _EXPORTS = {
     "run_scenario": ".scenarios",
 }
 
-__all__ = sorted(_EXPORTS) + ["faults", "scenarios", "store", "supervisor"]
+__all__ = sorted(_EXPORTS) + ["faults", "scenarios", "sentinel", "store",
+                              "supervisor"]
 
 
 def __getattr__(name: str):

@@ -45,11 +45,39 @@ Fault kinds and their standard effects (applied by :func:`maybe_fire`):
                      replicas from its on-disk journal alone; a bare
                      :func:`maybe_fire` at the site raises
                      :class:`ReplicaLost`
+``nan-grad``         a numerically poisoned step: the sentinel-enabled
+                     trainer (``resilience/sentinel.py``) interprets it
+                     via :func:`check` by feeding the step a NaN-scaled
+                     batch, so the backward produces NaN gradients and
+                     the donated update destroys the params — the
+                     micro-rollback drill. A bare :func:`maybe_fire`
+                     raises :class:`NumericFault`
+``corrupt-batch``    a corrupted input batch (overflow-scaled values →
+                     non-finite loss); trainer-interpreted like
+                     ``nan-grad``, quarantined on detection
+``loss-spike``       a finite numeric excursion (inputs scaled 100x → a
+                     large but finite loss) for the EWMA spike detector;
+                     trainer-interpreted like ``nan-grad``
+``preempt``          a graceful preemption notice (the SIGTERM drill's
+                     in-process twin): the trainer finishes the in-flight
+                     step, forces a synchronous checkpoint + quarantine-
+                     journal flush and returns cleanly. A bare
+                     :func:`maybe_fire` raises :class:`Preempted`
 =================== ==================================================
 
 Injection sites threaded through the stack:
 
-- ``train.step``          (``train/trainer.py``, ctx: ``step``)
+- ``train.step``          (``train/trainer.py``, ctx: ``step``; also the
+                          ``loss-spike`` numeric site — the sentinel probes
+                          that kind via ``check(..., only=)`` before the
+                          generic ``maybe_fire`` excludes it)
+- ``train.grad``          (``train/trainer.py``, ctx: ``step`` — the
+                          ``nan-grad`` poisoned-gradient site)
+- ``data.batch``          (``train/trainer.py``, ctx: ``step`` — the
+                          ``corrupt-batch`` poisoned-input site)
+- ``train.sigterm``       (``train/trainer.py``, ctx: ``step`` — the
+                          ``preempt`` graceful-preemption site, probed once
+                          per step before the next step starts)
 - ``ckpt.write``          (``train/checkpoint.py``, ctx: ``path``, ``tmp``)
 - ``serve.tick``          (``serve/engine.py``, ctx: ``step`` = tick index)
 - ``serve.admit``         (``serve/engine.py::submit``, ctx: ``step`` = rid —
@@ -76,6 +104,8 @@ Grammar (``--chaos``): entries separated by ``;``, each
     host-kill@train.step=6
     slow-tick@serve.tick,dur=0.004,after=2,times=6
     frozen-peer@watchdog.heartbeat,rank=1
+    nan-grad@train.grad=12
+    corrupt-batch@data.batch=3;preempt@train.sigterm=20
 """
 
 from __future__ import annotations
@@ -85,10 +115,29 @@ import os
 import time
 
 KINDS = ("host-kill", "frozen-peer", "slow-tick", "ckpt-write-crash",
-         "wedged-device", "engine-crash", "replica-kill")
+         "wedged-device", "engine-crash", "replica-kill", "nan-grad",
+         "corrupt-batch", "loss-spike", "preempt")
 
-SITES = ("train.step", "ckpt.write", "serve.tick", "serve.admit",
-         "fleet.tick", "watchdog.heartbeat", "bench.probe")
+SITES = ("train.step", "train.grad", "data.batch", "train.sigterm",
+         "ckpt.write", "serve.tick", "serve.admit", "fleet.tick",
+         "watchdog.heartbeat", "bench.probe")
+
+#: kinds the numeric-anomaly sentinel (``resilience/sentinel.py``)
+#: interprets itself — a plan containing one of these needs a
+#: sentinel-enabled trainer, or the bare standard effect (a raised
+#: :class:`NumericFault`) kills the run loudly instead of being absorbed.
+SENTINEL_KINDS = ("nan-grad", "corrupt-batch", "loss-spike")
+
+#: kinds that are only meaningful at ONE site (and, for the sites below,
+#: sites that accept only one kind): any crossed pair would match-and-count
+#: without ever taking effect — the vacuous-drill failure the strict site
+#: check exists to stop.
+_KIND_SITE = {"replica-kill": "fleet.tick", "nan-grad": "train.grad",
+              "corrupt-batch": "data.batch", "preempt": "train.sigterm",
+              "loss-spike": "train.step"}
+_SITE_KINDS = {"fleet.tick": ("replica-kill",), "train.grad": ("nan-grad",),
+               "data.batch": ("corrupt-batch",),
+               "train.sigterm": ("preempt",)}
 
 ENV_VAR = "SDML_CHAOS"
 
@@ -132,6 +181,19 @@ class ReplicaLost(FaultInjected):
     dead replica's on-disk journal alone."""
 
 
+class NumericFault(FaultInjected):
+    """A numeric fault (nan-grad / corrupt-batch / loss-spike) fired at a
+    site nothing interprets: the sentinel-enabled trainer absorbs these via
+    :func:`check`; a bare :func:`maybe_fire` caller fails loudly instead of
+    letting the drill pass vacuously (enable the sentinel)."""
+
+
+class Preempted(FaultInjected):
+    """A graceful-preemption notice fired at a site nothing interprets: the
+    trainer absorbs ``preempt`` via :func:`check` (finish the step,
+    synchronous checkpoint, clean exit); a bare caller fails loudly."""
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     """One scheduled fault; see the module docstring for field semantics."""
@@ -155,15 +217,20 @@ class FaultSpec:
             raise ValueError(
                 f"unknown fault site {self.site!r}; instrumented sites: "
                 f"{SITES}")
-        if (self.kind == "replica-kill") != (self.site == "fleet.tick"):
-            # the fleet interprets ONLY replica-kill at its site, and no
-            # other instrumented site probes that kind — any crossed pair
-            # would match-and-count without ever taking effect, the
+        pinned = _KIND_SITE.get(self.kind)
+        if pinned is not None and self.site != pinned:
+            # a kind with exactly one interpreting site scheduled anywhere
+            # else would match-and-count without ever taking effect — the
             # vacuous-drill failure the strict site check exists to stop
             raise ValueError(
-                f"kind {self.kind!r} at site {self.site!r}: replica-kill "
-                f"and fleet.tick only pair with each other (the fleet is "
-                f"the sole interpreter of both)")
+                f"kind {self.kind!r} at site {self.site!r}: this kind only "
+                f"pairs with site {pinned!r} (its sole interpreter)")
+        allowed = _SITE_KINDS.get(self.site)
+        if allowed is not None and self.kind not in allowed:
+            raise ValueError(
+                f"kind {self.kind!r} at site {self.site!r}: this site only "
+                f"interprets {allowed} (any other kind would never take "
+                f"effect there)")
         if self.after < 0 or self.times < 0 or self.dur < 0:
             raise ValueError(
                 f"after/times/dur must be >= 0, got {self.after}/"
@@ -238,20 +305,38 @@ class FaultPlan:
         rng = np.random.default_rng(seed)
         steps = sorted(int(s) for s in
                        rng.choice(max_step, size=n, replace=False))
-        specs = [FaultSpec(kind=str(rng.choice(list(kinds))),
-                           site=str(rng.choice(list(sites))),
-                           step=step)
-                 for step in steps]
+        specs = []
+        for step in steps:
+            kind = str(rng.choice(list(kinds)))
+            # site-pinned kinds (nan-grad, corrupt-batch, loss-spike,
+            # preempt, replica-kill) land on their interpreting site; a
+            # free draw would hit the pairing check and a random schedule
+            # must always be a VALID schedule
+            pinned = _KIND_SITE.get(kind)
+            site = pinned if pinned else str(rng.choice(list(sites)))
+            specs.append(FaultSpec(kind=kind, site=site, step=step))
         return cls(specs, sleep=sleep)
 
     # -- matching ----------------------------------------------------------
 
-    def check(self, site: str, **ctx) -> list[FaultSpec]:
+    def check(self, site: str, only=None, exclude=(),
+              **ctx) -> list[FaultSpec]:
         """Specs firing for this call (matching + occurrence accounting,
-        no effects applied)."""
+        no effects applied).
+
+        ``only``/``exclude`` filter by KIND before any occurrence
+        accounting — a filtered-out spec is not "seen", so a caller that
+        splits one site's kinds across two probes (the sentinel-enabled
+        trainer checks ``loss-spike`` itself and excludes it from the
+        generic ``maybe_fire``) still matches every spec exactly once.
+        """
         fired = []
         for i, spec in enumerate(self.specs):
             if spec.site != site:
+                continue
+            if only is not None and spec.kind not in only:
+                continue
+            if spec.kind in exclude:
                 continue
             if spec.rank is not None and ctx.get("rank") != spec.rank:
                 continue
@@ -267,11 +352,12 @@ class FaultPlan:
             fired.append(spec)
         return fired
 
-    def fire(self, site: str, **ctx) -> list[FaultSpec]:
+    def fire(self, site: str, only=None, exclude=(),
+             **ctx) -> list[FaultSpec]:
         """``check`` + standard effects. Sleeping faults are applied first
         so a site scheduled with both a slow-tick and a host-kill stalls,
         then dies — the order a real degrading host fails in."""
-        fired = self.check(site, **ctx)
+        fired = self.check(site, only=only, exclude=exclude, **ctx)
         for spec in fired:
             if spec.kind in ("slow-tick", "frozen-peer"):
                 self.sleep(spec.dur)
@@ -286,6 +372,13 @@ class FaultPlan:
                 # the fleet interprets this kind via check() and never gets
                 # here; a bare maybe_fire caller still fails loudly
                 raise ReplicaLost(spec, site)
+            if spec.kind in SENTINEL_KINDS:
+                # the sentinel-enabled trainer interprets these via check()
+                # and never gets here; without the sentinel the drill must
+                # fail loudly, not pass vacuously
+                raise NumericFault(spec, site)
+            if spec.kind == "preempt":
+                raise Preempted(spec, site)
             if spec.kind == "ckpt-write-crash":
                 tmp = ctx.get("tmp")
                 if tmp:
@@ -337,16 +430,17 @@ def install_from_env(var: str = ENV_VAR) -> FaultPlan | None:
     return install(FaultPlan.parse(text))
 
 
-def maybe_fire(site: str, **ctx) -> list[FaultSpec]:
+def maybe_fire(site: str, only=None, exclude=(), **ctx) -> list[FaultSpec]:
     """The instrumented-code entry point: a no-op unless a plan is active."""
     if _ACTIVE is None:
         return []
-    return _ACTIVE.fire(site, **ctx)
+    return _ACTIVE.fire(site, only=only, exclude=exclude, **ctx)
 
 
-def check(site: str, **ctx) -> list[FaultSpec]:
+def check(site: str, only=None, exclude=(), **ctx) -> list[FaultSpec]:
     """Match without effects (callers that interpret the fault themselves,
-    e.g. the watchdog's frozen-peer); no-op unless a plan is active."""
+    e.g. the watchdog's frozen-peer or the sentinel trainer's numeric
+    kinds); no-op unless a plan is active."""
     if _ACTIVE is None:
         return []
-    return _ACTIVE.check(site, **ctx)
+    return _ACTIVE.check(site, only=only, exclude=exclude, **ctx)

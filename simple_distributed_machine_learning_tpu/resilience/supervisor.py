@@ -43,6 +43,9 @@ from simple_distributed_machine_learning_tpu.resilience.faults import (
     DeviceWedged,
     HostLost,
 )
+from simple_distributed_machine_learning_tpu.resilience.sentinel import (
+    SentinelExhausted,
+)
 from simple_distributed_machine_learning_tpu.resilience.store import (
     CheckpointStore,
 )
@@ -58,8 +61,11 @@ class PeerLost(RuntimeError):
 
 #: failures the supervisor restarts through; anything else is a bug and
 #: propagates. Host/peer loss additionally shrinks the topology (the dead
-#: host's devices are gone); write crashes and device wedges retry in place.
-RECOVERABLE = (HostLost, PeerLost, CheckpointWriteCrash, DeviceWedged)
+#: host's devices are gone); write crashes, device wedges and an exhausted
+#: anomaly sentinel (micro-rollback could not absorb a systematic numeric
+#: fault — escalate to a full disk restore) retry in place.
+RECOVERABLE = (HostLost, PeerLost, CheckpointWriteCrash, DeviceWedged,
+               SentinelExhausted)
 _SHRINKING = (HostLost, PeerLost)
 
 
@@ -99,15 +105,24 @@ class ElasticTrainer(Trainer):
                 "ElasticTrainer persists through its CheckpointStore; "
                 "config.checkpoint_dir must be None (the two would race "
                 "over who owns resume)")
+        # before super().__init__: the base constructor resolves the
+        # sentinel's quarantine-journal directory via _sentinel_dir()
+        self.store = store
         super().__init__(pipe, train_ds, test_ds, config, opt=opt,
                          telemetry=telemetry)
-        self.store = store
         self.history: list[dict] = []
 
-    def _save(self, epoch: int) -> None:
+    def _sentinel_dir(self) -> str | None:
+        # the quarantine journal lives next to the checkpoint generations,
+        # so a restarted attempt skips the same batches
+        return self.store.dir
+
+    def _save(self, epoch: int, cursor: int | None = None,
+              sync: bool = False) -> None:
+        extra = self._save_extra(epoch, cursor)
+        extra["n_stages"] = self.pipe.n_stages
         self.store.save(self.buf, self.opt_state, self._step_count,
-                        extra={"epoch": epoch,
-                               "n_stages": self.pipe.n_stages})
+                        extra=extra)
 
     def _log_metrics(self, record: dict) -> None:
         self.history.append(dict(record))
@@ -152,11 +167,20 @@ def make_elastic_trainer(build_pipe, n_stages: int, store: CheckpointStore,
     trainer.opt_state = st["opt_state"]
     trainer._step_count = st["step"]
     trainer.start_epoch = int(st["extra"].get("epoch", 0)) + 1
+    # a graceful-preemption checkpoint carries the mid-epoch data cursor:
+    # resume re-enters the epoch at the exact next batch. The sentinel's
+    # EWMA detector state rides along too (a spike right after resume
+    # must not slip through a cold detector).
+    trainer._resume_batch_idx = int(st["extra"].get("next_batch", 0))
+    if trainer._sentinel is not None and "sentinel" in st["extra"]:
+        trainer._sentinel.restore_detector(st["extra"]["sentinel"])
     trainer._print(
         f"| elastic: restored {entry['file']} (step {st['step']}, written "
         f"at {src_n} stage{'s' if src_n != 1 else ''}"
         + (f", repacked onto {n_stages}" if src_n != n_stages else "")
-        + f"); resuming at epoch {trainer.start_epoch}")
+        + f"); resuming at epoch {trainer.start_epoch}"
+        + (f" (batch {trainer._resume_batch_idx})"
+           if trainer._resume_batch_idx else ""))
     return trainer
 
 
@@ -198,6 +222,9 @@ def supervise(build_trainer, topologies, *, policy: RestartPolicy | None = None,
             attempt.update(outcome="fault", fault=type(e).__name__,
                            detail=str(e)[:200],
                            history=list(trainer.history))
+            stats = getattr(trainer, "sentinel_stats", lambda: None)()
+            if stats is not None:
+                attempt["sentinel"] = stats
             report["attempts"].append(attempt)
             restarts += 1
             report["restarts"] = restarts
@@ -220,6 +247,9 @@ def supervise(build_trainer, topologies, *, policy: RestartPolicy | None = None,
                           policy.max_backoff_s)
             continue
         attempt.update(outcome="completed", history=list(trainer.history))
+        stats = getattr(trainer, "sentinel_stats", lambda: None)()
+        if stats is not None:
+            attempt["sentinel"] = stats
         report["attempts"].append(attempt)
         report["completed"] = True
         note("DONE", n_stages)

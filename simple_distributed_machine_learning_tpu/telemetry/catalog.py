@@ -43,6 +43,19 @@ EXTRA_HELP: dict[str, str] = {
     "step_time_ms": "per-step wall latency from fenced timing windows",
     "ici_bytes_per_step": "statically expected collective bytes per step "
                           "over the interconnect",
+    # self-healing training (resilience/sentinel.py)
+    "train_anomalies_total": "numeric anomalies the training sentinel "
+                             "detected, by verdict kind (nan/inf/spike)",
+    "train_rollbacks_total": "in-memory micro-rollbacks to a snapshot-ring "
+                             "entry (no disk restore)",
+    "train_quarantined_batches_total": "batches journaled as quarantined "
+                                       "and deterministically skipped",
+    "train_snapshot_ring_bytes": "resident host bytes of the sentinel's "
+                                 "bounded snapshot ring",
+    "train_preempt_graceful": "1 when the run ended on a graceful "
+                              "preemption (SIGTERM): in-flight step "
+                              "finished, synchronous checkpoint + "
+                              "quarantine-journal flush",
 }
 
 _NAME_RE = re.compile(r"``([A-Za-z_][A-Za-z0-9_]*)(?:\{[^`]*\})?``")

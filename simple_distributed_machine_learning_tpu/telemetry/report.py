@@ -6,7 +6,9 @@
 request-scoped trace timeline(s) and any post-mortem bundles — as one
 summary: per-class SLO attainment, the shed breakdown, the restart
 timeline (journal ``restart`` events with their monotonic ticks), TTFT /
-TPOT quantiles, KV-drift, and the bundle inventory. ``--json`` emits the
+TPOT quantiles, KV-drift, the training-resilience block (the self-healing
+sentinel's anomaly/rollback/quarantine counters and per-event timeline
+from the epoch records), and the bundle inventory. ``--json`` emits the
 same content as one machine-readable object.
 
 This module is deliberately stdlib-only (``json``/``os``/``glob``/
@@ -117,12 +119,72 @@ def collect(outdir: str) -> dict:
             bundles.append({"file": os.path.basename(path),
                             "error": "unparseable"})
 
+    # the training-resilience block (self-healing sentinel): counters are
+    # cumulative WITHIN one process but reset when the run restarts
+    # (graceful-preempt resume, a supervisor restart) — so totals are
+    # summed across process generations (a counter DROPPING marks a new
+    # generation), not read off the newest record, or a resumed clean run
+    # would report "0 anomalies" above a non-empty anomaly timeline.
+    sent_recs = [r for r in epochs if r.get("rollbacks") is not None]
+    sentinel = None
+    if sent_recs:
+        generations: list[list[dict]] = [[]]
+        prev = -1
+        for r in sent_recs:
+            v = r.get("anomalies", 0) or 0
+            if generations[-1]:
+                # primary boundary signal: the per-sentinel run id each
+                # record carries; fallback for id-less records is a counter
+                # DROP (which misses a resumed run that re-accumulates past
+                # the previous generation before its first record — hence
+                # the id)
+                pk = generations[-1][-1].get("sentinel_run")
+                key = r.get("sentinel_run")
+                if (key != pk) if (key is not None or pk is not None) \
+                        else v < prev:
+                    generations.append([])
+            generations[-1].append(r)
+            prev = v
+
+        def total(key):
+            return sum(g[-1].get(key, 0) or 0 for g in generations)
+
+        by_kind: dict[str, int] = {}
+        for g in generations:
+            for kind, n in (g[-1].get("by_kind") or {}).items():
+                by_kind[kind] = by_kind.get(kind, 0) + int(n)
+        # quarantine totals: a PERSISTENT journal (on disk next to the
+        # checkpoints) carries the previous generation's count forward on
+        # reload, so consecutive persistent generations dedup against the
+        # predecessor's last value; an in-memory generation restarted from
+        # zero contributes its whole count
+        quarantined = 0
+        prev_last = 0
+        prev_persistent = False
+        for g in generations:
+            last = g[-1].get("quarantined_batches", 0) or 0
+            persistent = bool(g[-1].get("quarantine_persistent"))
+            carried = prev_last if (persistent and prev_persistent) else 0
+            quarantined += max(0, last - carried)
+            prev_last, prev_persistent = last, persistent
+        sentinel = {
+            "anomalies": total("anomalies"),
+            "by_kind": by_kind,
+            "rollbacks": total("rollbacks"),
+            "quarantined_batches": quarantined,
+            "snapshot_ring_bytes": sent_recs[-1].get(
+                "snapshot_ring_bytes", 0),
+            "events": [e for r in sent_recs
+                       for e in (r.get("anomaly_events") or [])],
+        }
+
     return {
         "dir": outdir,
         "serve": serve[-1] if serve else None,
         "scenarios": scenarios,
         "epochs": len(epochs),
         "last_epoch": epochs[-1] if epochs else None,
+        "sentinel": sentinel,
         "journals": journals,
         "timelines": timelines,
         "traces": traces,
@@ -229,6 +291,21 @@ def render(report: dict) -> str:
             + (f" measured {_fmt(le.get('bubble_fraction_measured'))}"
                f" drift {_fmt(le.get('bubble_drift'))}"
                if le.get("bubble_drift") is not None else ""))
+    sent = report.get("sentinel")
+    if sent:
+        ok = "OK" if sent["anomalies"] == sent["rollbacks"] == 0 else \
+            "SELF-HEALED"
+        lines.append(
+            f"  self-healing: {sent['anomalies']} anomal"
+            f"{'y' if sent['anomalies'] == 1 else 'ies'} {sent['by_kind']}"
+            f", {sent['rollbacks']} rollback(s), "
+            f"{sent['quarantined_batches']} quarantined batch(es), ring "
+            f"{sent['snapshot_ring_bytes']} bytes [{ok}]")
+        for e in sent["events"]:
+            lines.append(
+                f"    anomaly @step {e.get('step')} [{e.get('kind')}] "
+                f"epoch {e.get('epoch')} batch {e.get('batch')} value "
+                f"{_fmt(e.get('value'))} -> rollback + quarantine")
     return "\n".join(lines)
 
 

@@ -17,7 +17,8 @@ from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
 from simple_distributed_machine_learning_tpu.train.optimizer import Optimizer
 
 
-def make_train_step(pipe: Pipeline, opt: Optimizer):
+def make_train_step(pipe: Pipeline, opt: Optimizer,
+                    with_grad_norm: bool = False):
     """Returns ``step(buf, opt_state, x, targets, key) -> (buf, opt_state, loss)``.
 
     The whole pipeline fwd + bwd + update is one XLA program: the forward
@@ -25,7 +26,14 @@ def make_train_step(pipe: Pipeline, opt: Optimizer):
     stage's owner-local optimizer update all schedule together, letting XLA
     overlap ICI transfer with compute — the overlap the reference's blocking
     RPC design structurally cannot have (SURVEY §3.3).
+
+    ``with_grad_norm``: the step additionally returns the global L2 norm of
+    the packed gradient buffer as a fourth output — the one extra scalar the
+    numeric-anomaly sentinel (``resilience/sentinel.py``) watches for
+    NaN/Inf alongside the loss. Computed from the gradients the update
+    consumes anyway; the loss math is unchanged.
     """
+    import jax.numpy as jnp
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(buf, opt_state, x, targets, key, weights=None):
@@ -37,6 +45,10 @@ def make_train_step(pipe: Pipeline, opt: Optimizer):
                                           deterministic=False,
                                           weights=weights)
         buf2, opt_state2 = opt.update(grads, opt_state, buf)
+        if with_grad_norm:
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(
+                grads.astype(jnp.float32))))
+            return buf2, opt_state2, loss, gnorm
         return buf2, opt_state2, loss
 
     return step

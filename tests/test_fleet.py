@@ -705,9 +705,9 @@ def test_replica_kill_fault_kind_plumbing():
         faults.FaultPlan.parse("replica-kill@fleet.tock=2")
     # the kind<->site pairing: any crossed combination would match and
     # count as fired without ever taking effect — refused at parse time
-    with pytest.raises(ValueError, match="only pair with each other"):
+    with pytest.raises(ValueError, match="only interprets"):
         faults.FaultPlan.parse("engine-crash@fleet.tick=2")
-    with pytest.raises(ValueError, match="only pair with each other"):
+    with pytest.raises(ValueError, match="only pairs with site"):
         faults.FaultPlan.parse("replica-kill@serve.tick=2")
 
 

@@ -1,0 +1,613 @@
+"""Self-healing training (resilience/sentinel.py): detection, rollback,
+quarantine, escalation, preemption.
+
+THE acceptance pin lives here: with ``nan-grad@train.grad=K`` injected, the
+sentinel run detects the NaN at step K, rolls back to the newest in-memory
+snapshot, quarantines the offending batch and replays — and its per-step
+losses equal a clean run that pre-loaded the same quarantine journal and
+never saw the fault, EXACTLY, on the single-stage and the 2-stage pipeline
+layouts. Plus: corrupt-batch determinism across runs, the EWMA spike
+threshold (with its no-false-positive guarantee on a normal warmup run),
+the snapshot ring's memory bound, escalation to the elastic supervisor on
+ring exhaustion, graceful preemption (injected + real SIGTERM), the new
+fault grammar, and the CLI surface.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.data.mnist import Dataset
+from simple_distributed_machine_learning_tpu.models.mlp import make_mlp_stages
+from simple_distributed_machine_learning_tpu.parallel.mesh import make_mesh
+from simple_distributed_machine_learning_tpu.parallel.pipeline import Pipeline
+from simple_distributed_machine_learning_tpu.resilience import (
+    CheckpointStore,
+    RestartPolicy,
+    faults,
+    make_elastic_trainer,
+    supervise,
+)
+from simple_distributed_machine_learning_tpu.resilience.sentinel import (
+    QuarantineJournal,
+    Sentinel,
+    SentinelConfig,
+    SentinelExhausted,
+    Snapshot,
+    SnapshotRing,
+)
+from simple_distributed_machine_learning_tpu.train.trainer import (
+    TrainConfig,
+    Trainer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _tiny_ds():
+    rng = np.random.RandomState(0)
+    return Dataset(rng.randn(120, 12).astype(np.float32),
+                   rng.randint(0, 10, 120))
+
+
+_DIMS = [12, 16, 14, 16, 10]
+
+
+def _build_pipe(n):
+    stages, wd, od = make_mlp_stages(jax.random.key(0), _DIMS, n)
+    return Pipeline(stages, make_mesh(n_stages=n, n_data=1,
+                                      devices=jax.devices()[:n]), wd, od)
+
+
+def _cfg(checkpoint_dir=None, **kw):
+    base = dict(epochs=3, batch_size=30, print_throughput=False,
+                sentinel=True, sentinel_snapshot_every=2,
+                checkpoint_dir=checkpoint_dir)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: new kinds/sites
+
+
+def test_new_fault_kinds_parse_and_pair_strictly():
+    p = faults.FaultPlan.parse(
+        "nan-grad@train.grad=12;corrupt-batch@data.batch=3;"
+        "loss-spike@train.step=7;preempt@train.sigterm=20")
+    assert [(s.kind, s.site, s.step) for s in p.specs] == [
+        ("nan-grad", "train.grad", 12), ("corrupt-batch", "data.batch", 3),
+        ("loss-spike", "train.step", 7), ("preempt", "train.sigterm", 20)]
+    # a typo'd site must still fail loudly (the vacuous-drill guard)
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultPlan.parse("nan-grad@train.grads=12")
+    # crossed kind<->site pairs are refused at parse time
+    with pytest.raises(ValueError, match="only pairs with site"):
+        faults.FaultPlan.parse("nan-grad@train.step=12")
+    with pytest.raises(ValueError, match="only pairs with site"):
+        faults.FaultPlan.parse("loss-spike@data.batch=3")
+    with pytest.raises(ValueError, match="only interprets"):
+        faults.FaultPlan.parse("host-kill@train.grad=3")
+    with pytest.raises(ValueError, match="only interprets"):
+        faults.FaultPlan.parse("slow-tick@train.sigterm=3")
+
+
+def test_fault_random_covers_new_kinds_with_valid_sites():
+    kinds = ("nan-grad", "corrupt-batch", "loss-spike", "preempt")
+    a = faults.FaultPlan.random(11, n=8, kinds=kinds,
+                                sites=("train.step",), max_step=50)
+    b = faults.FaultPlan.random(11, n=8, kinds=kinds,
+                                sites=("train.step",), max_step=50)
+    # every drawn spec is VALID (site-pinned kinds landed on their
+    # interpreting sites) and the schedule is seed-deterministic
+    assert ([(s.kind, s.site, s.step) for s in a.specs]
+            == [(s.kind, s.site, s.step) for s in b.specs])
+    assert {s.kind for s in a.specs} <= set(kinds)
+    for s in a.specs:
+        assert s.site == faults._KIND_SITE[s.kind]
+
+
+def test_numeric_fault_without_sentinel_fails_loudly():
+    """Against an undefended trainer the numeric kinds must raise, not be
+    silently counted — a drill can never pass vacuously."""
+    faults.install(faults.FaultPlan.parse("nan-grad@train.grad=0"))
+    ds = _tiny_ds()
+    tr = Trainer(_build_pipe(1), ds, ds,
+                 _cfg(sentinel=False, epochs=1))
+    with pytest.raises(faults.NumericFault):
+        tr.fit()
+
+
+def test_check_only_exclude_filters_without_consuming():
+    plan = faults.install(faults.FaultPlan.parse("loss-spike@train.step=3"))
+    # excluded probes do not consume the occurrence...
+    assert faults.maybe_fire("train.step", step=3,
+                             exclude=("loss-spike",)) == []
+    # ...so the interpreting probe still matches it exactly once
+    fired = faults.check("train.step", step=3, only=("loss-spike",))
+    assert [s.kind for s in fired] == ["loss-spike"]
+    assert plan.stats()["total_fired"] == 1
+
+
+# ---------------------------------------------------------------------------
+# snapshot ring + quarantine journal units
+
+
+def _snap(step, nbytes=100):
+    return Snapshot(step=step, epoch=1, batch_idx=step, params=None,
+                    opt_leaves=(), ewma=None, healthy=0, nbytes=nbytes)
+
+
+def test_snapshot_ring_bound_and_lookup():
+    ring = SnapshotRing(3)
+    for s in (0, 2, 4, 6):
+        ring.push(_snap(s))
+    assert len(ring) == 3                       # oldest aged out
+    assert ring.bytes() == 300
+    assert ring.newest_at_or_before(5).step == 4
+    assert ring.newest_at_or_before(6).step == 6   # pre-step snapshots:
+    assert ring.newest_at_or_before(1) is None     # the anomaly step's own
+    ring.push(_snap(6, nbytes=50))              # re-snapshot same step
+    assert len(ring) == 3 and ring.bytes() == 250
+
+
+def test_quarantine_journal_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "quarantine.jsonl")
+    j = QuarantineJournal(path)
+    j.add({"epoch": 2, "batch": 3, "step": 11, "kind": "nan", "value": None})
+    j.add({"epoch": 1, "batch": 0, "step": 0, "kind": "spike", "value": 9.0})
+    with open(path, "a") as f:
+        f.write('{"epoch": 5, "ba')        # torn tail from a crash
+    j2 = QuarantineJournal(path)
+    assert len(j2) == 2
+    assert j2.skip(2, 3) and j2.skip(1, 0) and not j2.skip(2, 4)
+
+
+def test_sentinel_config_validation():
+    with pytest.raises(ValueError, match="window"):
+        SentinelConfig(window=1)
+    with pytest.raises(ValueError, match="snapshot_every"):
+        SentinelConfig(snapshot_every=0)
+    with pytest.raises(ValueError, match="ring_size"):
+        SentinelConfig(ring_size=0)
+    with pytest.raises(ValueError, match="spike_factor"):
+        SentinelConfig(spike_factor=1.0)
+
+
+def test_observe_ewma_excludes_anomalies():
+    s = Sentinel(SentinelConfig(warmup_steps=2, spike_factor=2.0,
+                                spike_margin=0.0))
+    for i, loss in enumerate((1.0, 1.0, 1.0)):
+        assert s.observe(i, 1, i, loss) is None
+    a = s.observe(3, 1, 3, 5.0)                 # 5 > 2 * ewma(1.0)
+    assert a is not None and a.kind == "spike"
+    # the spike did NOT enter the EWMA: the same value trips again
+    assert s.observe(4, 1, 4, 5.0).kind == "spike"
+    assert s.observe(5, 1, 5, float("nan")).kind == "nan"
+    assert s.observe(6, 1, 6, 1.0, gnorm=float("inf")).kind == "inf"
+    assert s.n_anomalies == 4
+    assert sorted(s.observed) == [0, 1, 2]      # healthy steps only
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance pin: nan-grad rollback bit-exact vs a clean run
+
+
+@pytest.mark.parametrize("n_stages", [1, 2])
+def test_nan_grad_rollback_bit_exact_vs_clean_run(tmp_path, n_stages):
+    """Injected NaN gradients at step 6 -> detect, roll back to the
+    (pre-step) snapshot, quarantine the batch, replay. The recovered run's
+    per-step losses equal a clean run that pre-loaded the same quarantine
+    journal and never saw the fault — EXACT float equality, both pipeline
+    layouts."""
+    ds = _tiny_ds()
+    dirty, clean_dir = str(tmp_path / "dirty"), str(tmp_path / "clean")
+
+    faults.install(faults.FaultPlan.parse("nan-grad@train.grad=6"))
+    tr = Trainer(_build_pipe(n_stages), ds, ds, _cfg(dirty))
+    tr.fit()
+    faults.uninstall()
+    assert tr.sentinel.n_anomalies == 1 and tr.sentinel.n_rollbacks == 1
+    [q] = tr.sentinel.journal.records
+    assert (q["step"], q["kind"]) == (6, "nan")
+
+    # the clean reference: same config, SAME quarantine journal (loaded
+    # from disk — the deterministic-skip contract), no fault installed
+    os.makedirs(clean_dir)
+    with open(os.path.join(dirty, "quarantine.jsonl")) as f:
+        journal = f.read()
+    with open(os.path.join(clean_dir, "quarantine.jsonl"), "w") as f:
+        f.write(journal)
+    ref = Trainer(_build_pipe(n_stages), ds, ds, _cfg(clean_dir))
+    ref.fit()
+    assert ref.sentinel.n_anomalies == 0 and ref.sentinel.n_rollbacks == 0
+
+    # bit-exact: every executed step's loss, including the replayed ones
+    assert tr.sentinel.observed == ref.sentinel.observed
+    assert len(tr.sentinel.observed) == 11     # 3 epochs x 4 - 1 skipped
+
+
+def test_corrupt_batch_quarantine_deterministic_across_runs():
+    """Two identical runs under the same corrupt-batch schedule produce
+    byte-identical quarantine records and per-step losses (the seeded
+    chaos contract extended to the sentinel's recovery)."""
+    ds = _tiny_ds()
+    results = []
+    for _ in range(2):
+        faults.install(faults.FaultPlan.parse("corrupt-batch@data.batch=5"))
+        tr = Trainer(_build_pipe(1), ds, ds, _cfg())
+        tr.fit()
+        faults.uninstall()
+        results.append((dict(tr.sentinel.observed),
+                        list(tr.sentinel.journal.records)))
+    assert results[0] == results[1]
+    [q] = results[0][1]
+    assert q["step"] == 5 and q["kind"] in ("nan", "inf")
+    assert (q["epoch"], q["batch"]) == (2, 1)   # 4 steps/epoch
+
+
+# ---------------------------------------------------------------------------
+# loss-spike EWMA threshold
+
+
+def test_loss_spike_no_false_positive_on_warmup_run():
+    """A normal lr-warmup run (the regime with the most natural loss
+    movement) must trip NOTHING: zero anomalies, zero rollbacks."""
+    from simple_distributed_machine_learning_tpu.train import schedules
+    from simple_distributed_machine_learning_tpu.train.optimizer import sgd
+    ds = _tiny_ds()
+    tr = Trainer(_build_pipe(1), ds, ds, _cfg(),
+                 opt=sgd(schedules.warmup_cosine(0.1, 6, 12), 0.5))
+    tr.fit()
+    assert tr.sentinel.n_anomalies == 0
+    assert tr.sentinel.n_rollbacks == 0
+    assert len(tr.sentinel.observed) == 12      # every step healthy
+
+
+def test_loss_spike_detected_and_rolled_back():
+    ds = _tiny_ds()
+    faults.install(faults.FaultPlan.parse("loss-spike@train.step=10"))
+    tr = Trainer(_build_pipe(1), ds, ds, _cfg())
+    tr.fit()
+    faults.uninstall()
+    assert tr.sentinel.by_kind == {"spike": 1}
+    assert tr.sentinel.n_rollbacks == 1
+    [q] = tr.sentinel.journal.records
+    assert q["step"] == 10 and q["kind"] == "spike"
+    assert q["value"] is not None              # finite excursion, recorded
+
+
+# ---------------------------------------------------------------------------
+# snapshot-ring memory bound (the gauge's contract)
+
+
+def test_snapshot_ring_memory_bound_and_gauge():
+    from simple_distributed_machine_learning_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+    ds = _tiny_ds()
+    reg = MetricsRegistry()
+    cfg = _cfg(sentinel_snapshot_every=1, sentinel_ring=3)
+    tr = Trainer(_build_pipe(1), ds, ds, cfg)
+    tr._sentinel.registry = reg                # gauge without a Telemetry
+    tr.fit()
+    sent = tr.sentinel
+    per_snapshot = (tr.buf.nbytes
+                    + sum(leaf.nbytes for leaf in
+                          jax.tree.leaves(tr.opt_state)))
+    assert len(sent.ring) == 3                 # bounded, snapshot-per-step
+    assert 0 < sent.ring.bytes() <= 3 * per_snapshot
+    assert (reg.gauge("train_snapshot_ring_bytes").value
+            == sent.ring.bytes())
+
+
+# ---------------------------------------------------------------------------
+# ring exhaustion -> elastic supervisor escalation
+
+
+def test_ring_exhaustion_raises_sentinel_exhausted():
+    ds = _tiny_ds()
+    # unlimited nan faults: every step anomalous, the rollback streak
+    # exceeds the budget and the sentinel escalates instead of looping
+    faults.install(faults.FaultPlan.parse("nan-grad@train.grad,times=0"))
+    tr = Trainer(_build_pipe(1), ds, ds, _cfg())
+    with pytest.raises(SentinelExhausted, match="exceed"):
+        tr.fit()
+    assert tr.sentinel.n_rollbacks == tr.config.sentinel_ring
+
+
+def test_escalation_recovers_through_elastic_supervisor(tmp_path):
+    """A systematic fault (6 consecutive nan steps) exhausts the ring; the
+    supervisor treats SentinelExhausted as RECOVERABLE, restores from the
+    store and the next attempt (fault schedule spent, quarantine journal
+    reloaded from the store dir) completes."""
+    ds = _tiny_ds()
+    store = CheckpointStore(str(tmp_path), keep=4)
+    faults.install(faults.FaultPlan.parse("nan-grad@train.grad,times=6"))
+    cfg = _cfg(checkpoint_dir=None)
+    report = supervise(
+        lambda n: make_elastic_trainer(_build_pipe, n, store, ds, ds, cfg),
+        (1,), policy=RestartPolicy(max_restarts=2), sleep=lambda s: None)
+    assert report["completed"] and report["restarts"] == 1
+    a1, a2 = report["attempts"]
+    assert a1["outcome"] == "fault" and a1["fault"] == "SentinelExhausted"
+    # the supervisor's attempt report carries the sentinel's counters
+    assert a1["sentinel"]["rollbacks"] >= 1
+    assert a1["sentinel"]["anomalies"] > a1["sentinel"]["rollbacks"]
+    assert a2["outcome"] == "completed"
+    # the quarantine journal persisted in the store dir across attempts
+    assert os.path.exists(os.path.join(str(tmp_path), "quarantine.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption: injected preempt fault + mid-epoch cursor resume
+
+
+def test_preempt_fault_graceful_stop_and_bit_exact_resume(tmp_path):
+    """preempt@train.sigterm=5: the in-flight step finishes, a SYNCHRONOUS
+    checkpoint carrying the data cursor is written, fit returns cleanly —
+    and the resumed run re-enters epoch 2 at batch 1, with the merged
+    per-step losses equal to an uninterrupted run's, exactly."""
+    ds = _tiny_ds()
+    ref = Trainer(_build_pipe(1), ds, ds, _cfg())
+    ref.fit()
+
+    ck = str(tmp_path / "ck")
+    mpath = str(tmp_path / "m.jsonl")
+    faults.install(faults.FaultPlan.parse("preempt@train.sigterm=5"))
+    p1 = Trainer(_build_pipe(1), ds, ds, _cfg(ck, metrics_json=mpath))
+    p1.fit()
+    faults.uninstall()
+    # the interrupted epoch still emitted a metrics record (sentinel
+    # counters re-assertable from artifacts even across a preemption)
+    recs = [json.loads(line) for line in open(mpath)]
+    assert recs[-1]["preempted"] is True and recs[-1]["step"] == 5
+    assert recs[-1]["rollbacks"] == 0 and "anomaly_events" in recs[-1]
+    assert p1.preempted and p1._step_count == 5
+    meta = json.load(open(os.path.join(ck, "state.npz.meta.json")))
+    assert meta["extra"]["epoch"] == 1 and meta["extra"]["next_batch"] == 1
+    # the EWMA detector state rides the checkpoint, so the resumed run's
+    # spike threshold matches the uninterrupted run's
+    assert meta["extra"]["sentinel"]["healthy"] == 5
+    assert meta["extra"]["sentinel"]["ewma"] is not None
+
+    p2 = Trainer(_build_pipe(1), ds, ds, _cfg(ck))
+    assert p2.start_epoch == 2 and p2._resume_batch_idx == 1
+    assert p2.sentinel.detector_state() == meta["extra"]["sentinel"]
+    p2.fit()
+    assert not p2.preempted
+    merged = dict(p1.sentinel.observed)
+    merged.update(p2.sentinel.observed)
+    assert merged == ref.sentinel.observed
+
+
+def test_preempt_in_epoch_record_metrics(tmp_path):
+    """The sentinel block rides the per-epoch metrics record (rollbacks
+    re-assertable from metrics.jsonl — the CI drill's anti-vacuous gate)."""
+    ds = _tiny_ds()
+    path = str(tmp_path / "metrics.jsonl")
+    faults.install(faults.FaultPlan.parse("nan-grad@train.grad=6"))
+    tr = Trainer(_build_pipe(1), ds, ds, _cfg(metrics_json=path))
+    tr.fit()
+    faults.uninstall()
+    records = [json.loads(line) for line in open(path)]
+    assert records[-1]["rollbacks"] == 1
+    assert records[-1]["anomalies"] == 1
+    assert records[-1]["quarantined_batches"] == 1
+    assert records[-1]["snapshot_ring_bytes"] > 0
+    # the anomaly event landed on ITS epoch's record, with the timeline
+    # fields the report CLI renders
+    ev = [e for r in records for e in r.get("anomaly_events", [])]
+    assert [e["step"] for e in ev] == [6]
+    assert ev[0]["kind"] == "nan"
+    assert tr.sentinel.drain_events() == []    # drained exactly once
+
+
+# ---------------------------------------------------------------------------
+# report CLI: the training-resilience block
+
+
+def test_report_cli_renders_self_healing_block(tmp_path):
+    from simple_distributed_machine_learning_tpu.telemetry import Telemetry
+    from simple_distributed_machine_learning_tpu.telemetry import report
+    ds = _tiny_ds()
+    outdir = str(tmp_path / "tele")
+    faults.install(faults.FaultPlan.parse("corrupt-batch@data.batch=5"))
+    tr = Trainer(_build_pipe(1), ds, ds, _cfg(),
+                 telemetry=Telemetry(outdir))
+    tr.fit()
+    faults.uninstall()
+    collected = report.collect(outdir)
+    assert collected["sentinel"]["rollbacks"] == 1
+    assert collected["sentinel"]["quarantined_batches"] == 1
+    assert [e["step"] for e in collected["sentinel"]["events"]] == [5]
+    text = report.render(collected)
+    assert "self-healing: 1 anomaly" in text
+    assert "anomaly @step 5" in text
+    # the counters also rode the Prometheus exposition, with HELP lines
+    prom = open(os.path.join(outdir, "metrics.prom")).read()
+    for name in ("train_anomalies_total", "train_rollbacks_total",
+                 "train_quarantined_batches_total",
+                 "train_snapshot_ring_bytes"):
+        assert f"# HELP {name}" in prom, name
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+def test_report_aggregates_counters_across_process_restarts(tmp_path):
+    """Sentinel counters reset when the process restarts (preempt resume,
+    supervisor restart) while the metrics.jsonl file persists — the report
+    must SUM across generations, not read the newest record, or a resumed
+    clean run would claim 0 anomalies above a non-empty timeline."""
+    from simple_distributed_machine_learning_tpu.telemetry import report
+    outdir = str(tmp_path)
+    recs = [
+        # generation 1: one absorbed anomaly, then a graceful preempt
+        {"kind": "epoch", "epoch": 1, "anomalies": 1, "rollbacks": 1,
+         "quarantined_batches": 1, "quarantine_persistent": True,
+         "snapshot_ring_bytes": 100,
+         "by_kind": {"nan": 1}, "sentinel_run": "aaaa0001",
+         "anomaly_events": [{"step": 6, "kind": "nan", "epoch": 1,
+                             "batch": 2, "value": None}]},
+        # generation 2 (resumed process, counters RESET; the journal
+        # reloaded from disk keeps quarantined cumulative). Its first
+        # record already re-accumulated PAST generation 1's count — the
+        # corner a pure counter-drop heuristic merges; the run id splits
+        {"kind": "epoch", "epoch": 2, "anomalies": 2, "rollbacks": 2,
+         "quarantined_batches": 3, "quarantine_persistent": True,
+         "snapshot_ring_bytes": 120,
+         "by_kind": {"spike": 2}, "sentinel_run": "bbbb0002",
+         "anomaly_events": [{"step": 20, "kind": "spike", "epoch": 2,
+                             "batch": 0, "value": 9.0}]},
+        {"kind": "epoch", "epoch": 3, "anomalies": 2, "rollbacks": 2,
+         "quarantined_batches": 3, "quarantine_persistent": True,
+         "snapshot_ring_bytes": 120,
+         "by_kind": {"spike": 2}, "sentinel_run": "bbbb0002",
+         "anomaly_events": []},
+    ]
+    with open(os.path.join(outdir, "metrics.jsonl"), "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    sent = report.collect(outdir)["sentinel"]
+    assert sent["anomalies"] == 3 and sent["rollbacks"] == 3
+    assert sent["by_kind"] == {"nan": 1, "spike": 2}
+    assert sent["quarantined_batches"] == 3
+    assert len(sent["events"]) == 2
+    text = report.render(report.collect(outdir))
+    assert "self-healing: 3 anomalies" in text
+    assert "[SELF-HEALED]" in text
+    # id-less records (hand-built / foreign) fall back to drop detection
+    with open(os.path.join(outdir, "metrics.jsonl"), "w") as f:
+        for r in recs:
+            r = dict(r)
+            r.pop("sentinel_run")
+            f.write(json.dumps(r) + "\n")
+    sent = report.collect(outdir)["sentinel"]
+    assert sent["anomalies"] == 2          # drop-rule merges 1 -> 2 -> 2
+
+
+def test_cli_sentinel_flag_validation():
+    from simple_distributed_machine_learning_tpu.cli import main
+    with pytest.raises(SystemExit, match="--sentinel-window"):
+        main(["--rank", "0", "--model", "mlp", "--sentinel",
+              "--sentinel-window", "1"])
+    with pytest.raises(SystemExit, match="--sentinel-snapshot-every"):
+        main(["--rank", "0", "--model", "mlp", "--sentinel",
+              "--sentinel-snapshot-every", "0"])
+    # numeric kinds in a --chaos plan need the sentinel armed
+    with pytest.raises(SystemExit, match="add --sentinel"):
+        main(["--rank", "0", "--model", "mlp", "--chaos",
+              "nan-grad@train.grad=5", "--checkpoint-dir", "/tmp/x"])
+
+
+def test_cli_sentinel_chaos_drill_end_to_end(tmp_path, capsys):
+    """The CI sentinel drill's in-process twin: nan-grad at step 5 under
+    --sentinel --chaos -> absorbed in-memory (0 supervisor restarts), exit
+    clean, quarantine journal written into the store dir."""
+    from simple_distributed_machine_learning_tpu.cli import main
+    main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+          "--mlp-dims", "784,16,10", "--stages", "1", "--epochs", "2",
+          "--max-steps-per-epoch", "4", "--data-root", "/nonexistent",
+          "--checkpoint-dir", str(tmp_path / "store"), "--sentinel",
+          "--chaos", "nan-grad@train.grad=5"])
+    out = capsys.readouterr().out
+    assert "chaos: completed after 0 restart(s)" in out
+    assert "sentinel absorbed 1 anomaly (1 rollback(s), 1 quarantined " \
+           "batch(es))" in out
+    q = [json.loads(line) for line in
+         open(tmp_path / "store" / "quarantine.jsonl")]
+    assert [r["step"] for r in q] == [5]
+
+
+def test_cli_chaos_never_fired_plan_is_vacuous(tmp_path):
+    """The min_anomalies-style gate: a chaos schedule that never fires
+    fails the run instead of passing green."""
+    from simple_distributed_machine_learning_tpu.cli import main
+    with pytest.raises(SystemExit, match="never fired"):
+        main(["--rank", "0", "--world_size", "1", "--model", "mlp",
+              "--mlp-dims", "784,16,10", "--stages", "1", "--epochs", "1",
+              "--max-steps-per-epoch", "2", "--data-root", "/nonexistent",
+              "--checkpoint-dir", str(tmp_path / "store"),
+              "--chaos", "host-kill@train.step=999"])
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM subprocess drill (the real signal path)
+
+
+@pytest.mark.slow
+def test_sigterm_graceful_preemption_subprocess(tmp_path):
+    """SIGTERM mid-training: the in-flight step finishes, a synchronous
+    checkpoint with the mid-epoch cursor is written, the run exits 0 —
+    and a rerun resumes from the cursor and completes."""
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = [sys.executable, "-m",
+            "simple_distributed_machine_learning_tpu.cli", "--rank", "0",
+            "--world_size", "1", "--model", "mlp",
+            "--mlp-dims", "784,32,10", "--epochs", "2",
+            "--data-root", "/nonexistent", "--sentinel",
+            "--checkpoint-dir", ck]
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env,
+                            cwd=REPO)
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("Train Epoch: 1 [6"):   # mid-epoch 1
+                break
+        else:
+            raise AssertionError("training never got under way")
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out
+    assert "preempt: graceful stop on signal 15" in out
+    assert "graceful shutdown complete" in out
+    meta = json.load(open(os.path.join(ck, "state.npz.meta.json")))
+    assert "next_batch" in meta["extra"]       # mid-epoch cursor persisted
+
+    # the rerun resumes from the cursor and completes cleanly
+    out2 = subprocess.run(args, capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=600)
+    assert out2.returncode == 0, out2.stdout + out2.stderr
+    assert f"(batch {meta['extra']['next_batch']})" in out2.stdout
+    meta2 = json.load(open(os.path.join(ck, "state.npz.meta.json")))
+    assert meta2["extra"]["epoch"] == 2        # ran to completion
+    assert "next_batch" not in meta2["extra"]
+
+
+# ---------------------------------------------------------------------------
+# bench rows
+
+
+@pytest.mark.slow
+def test_bench_sentinel_rows():
+    sys.path.insert(0, REPO)
+    import bench
+    rows = bench._measure_sentinel(n_steps=24, fault_step=14)
+    by = {r["config"]: r for r in rows}
+    ov = by["train_sentinel_overhead"]
+    assert ov["steps_per_sec_on"] > 0 and ov["steps_per_sec_off"] > 0
+    rec = by["train_sentinel_recovery"]
+    assert rec["recovered"] is True
+    assert rec["faults_fired"] == 1 and rec["rollbacks"] == 1
