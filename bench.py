@@ -631,7 +631,8 @@ def measure_decode(windows: int = 5, cfg=None, prompt_len: int = 32,
 def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                     slots: int = 8, max_new: int = 24, cfg=None,
                     prompt_lens: tuple = (8, 16, 32), block_size: int = 16,
-                    compare: bool = True, lint: bool = False) -> list[dict]:
+                    compare: bool = True, lint: bool = False,
+                    attn_kernel: str = "dense") -> list[dict]:
     """Offered-load sweep of the continuous-batching engine (serve/).
 
     One row per Poisson arrival rate through an ``slots``-slot engine, plus
@@ -671,7 +672,8 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
 
     default_shape = (cfg is None and slots == 8 and n_requests == 24
                      and max_new == 24 and rates == (2.0, 8.0, 32.0)
-                     and prompt_lens == (8, 16, 32) and block_size == 16)
+                     and prompt_lens == (8, 16, 32) and block_size == 16
+                     and attn_kernel == "dense")
     cfg = cfg or GPTConfig(vocab=8192, seq_len=256, d_model=512, n_heads=8,
                            n_layers=4)
     if max(prompt_lens) + max_new > cfg.seq_len:
@@ -694,9 +696,20 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
             # the sweep rows and the 1-slot sequential baseline (n_slots is
             # a traced shape: different compiled programs)
             ServeSpec(cfg, n_slots=slots, kv_layout="paged",
-                      block_size=block_size, prompt_lens=prompt_lens),
+                      block_size=block_size, prompt_lens=prompt_lens,
+                      attn_kernel=attn_kernel),
             ServeSpec(cfg, n_slots=1, kv_layout="paged",
-                      block_size=block_size, prompt_lens=prompt_lens),
+                      block_size=block_size, prompt_lens=prompt_lens,
+                      attn_kernel=attn_kernel),
+            # the kernel-comparison engines (both attention paths) and the
+            # int8 pool the quantized fixed-mem rows build — each a
+            # distinct compiled program family
+            ServeSpec(cfg, n_slots=slots, kv_layout="paged",
+                      block_size=block_size, prompt_lens=prompt_lens,
+                      attn_kernel="fused"),
+            ServeSpec(cfg, n_slots=slots, kv_layout="paged",
+                      block_size=block_size, prompt_lens=prompt_lens,
+                      cache_dtype="int8"),
             # the speculative comparison engines (draft == target): the
             # propose scan, the batched verify and the fused tick are
             # DIFFERENT compiled programs from the plain sweep's
@@ -737,7 +750,8 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
 
     def run(rate, n_slots, label):
         engine = InferenceEngine(stages, cfg, n_slots=n_slots,
-                                 block_size=block_size)
+                                 block_size=block_size,
+                                 attn_kernel=attn_kernel)
         # warm every compiled shape OUTSIDE the measured trace: one tiny
         # request per prompt-length bucket (prefill shapes) + decode ticks
         for t0 in prompt_lens:
@@ -775,6 +789,13 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                                        max_new=max_new,
                                        prompt_lens=prompt_lens,
                                        block_size=block_size)
+        # the ISSUE-15 rows: fused-kernel vs dense per-tick HBM bytes +
+        # ticks/sec, and the int8 pool's fixed-KV-bytes concurrency win
+        rows += _measure_kernel_and_quant(stages, cfg, slots=min(slots, 4),
+                                          n_requests=n_requests,
+                                          max_new=max_new,
+                                          prompt_lens=prompt_lens,
+                                          block_size=block_size)
         # the availability row: completed-within-deadline fraction while a
         # mid-flight engine crash restarts through the serve supervisor
         rows += _measure_availability(stages, cfg, slots=min(slots, 4),
@@ -835,6 +856,25 @@ def _compare_geometries(cfg, slots: int, max_new: int, prompt_lens: tuple,
     }
 
 
+def _drain_burst(engine, specs):
+    """Submit everything at t=0 and drive to empty — the one burst-drain
+    helper every comparison row family measures with. Returns
+    ``(handles, ticks, tokens, peak concurrent active, completed,
+    wall_s)``."""
+    import time as _time
+
+    handles = [engine.submit(**sp) for sp in specs]
+    ticks, toks, peak = 0, 0, 0
+    t0 = _time.perf_counter()
+    while engine.busy:
+        toks += engine.step()
+        ticks += 1
+        peak = max(peak, engine.pool.n_active)
+    wall = _time.perf_counter() - t0
+    done = sum(1 for h in handles if h.state == "done")
+    return handles, ticks, toks, peak, done, wall
+
+
 def _measure_paged_vs_dense(stages, cfg, slots: int, n_requests: int,
                             max_new: int, prompt_lens: tuple,
                             block_size: int,
@@ -872,16 +912,8 @@ def _measure_paged_vs_dense(stages, cfg, slots: int, n_requests: int,
            "backend": jax.default_backend()}
 
     def _burst(engine, specs):
-        """Submit everything at t=0; drive to empty; return (peak
-        concurrent active, completed, tokens/sec)."""
-        handles = [engine.submit(**sp) for sp in specs]
-        peak, toks = 0, 0
-        t0 = _time.perf_counter()
-        while engine.busy:
-            toks += engine.step()
-            peak = max(peak, engine.pool.n_active)
-        wall = _time.perf_counter() - t0
-        done = sum(1 for h in handles if h.state == "done")
+        """(peak concurrent active, completed, tokens/sec) of a burst."""
+        _h, _ticks, toks, peak, done, wall = _drain_burst(engine, specs)
         return peak, done, round(toks / wall, 1)
 
     def _spec(t0, i):
@@ -944,6 +976,154 @@ def _measure_paged_vs_dense(stages, cfg, slots: int, n_requests: int,
             "tick_ms_max": round(max(tick_ms), 3),
             "n_ticks": len(tick_ms), **dev,
         })
+    return out
+
+
+def _measure_kernel_and_quant(stages, cfg, slots: int, n_requests: int,
+                              max_new: int, prompt_lens: tuple,
+                              block_size: int) -> list[dict]:
+    """The ISSUE-15 serve-path rows: the fused Pallas paged-attention
+    kernel vs the gather-then-dense path, and the int8-quantized pool vs
+    bf16 at fixed KV bytes.
+
+    1. ``paged_attention_kernel`` (one row per kernel path) — the SAME
+       burst workload drained through ``attn_kernel="dense"`` and
+       ``"fused"`` engines: measured ticks/sec and tokens/sec ride along,
+       and each row carries the ANALYZER's per-tick decode K/V bytes
+       (``hbm_tick_costs`` over ``engine_spec`` — the exact deployment,
+       not a parallel description). The dense row's bytes include the
+       ``decode.kv_attn_reread`` pass the kernel eliminates, so
+       ``hbm_reduction`` on the fused row is the single-pass win (2x);
+       greedy token streams are asserted IDENTICAL across the two engines
+       (the bit-exactness anchor, run on every bench round).
+
+    2. ``gpt_serve_quantized_fixed_mem`` (one row per cache dtype) — a
+       bf16 pool and an int8 pool sized from the SAME byte budget
+       (``n_blocks_for_bytes``, scale planes billed), drained under an
+       all-at-once burst; ``max_concurrent`` is the resident-request
+       count the quantized pool exists to multiply. The int8 row carries
+       ``resident_ratio`` vs bf16 (the >= 2x gate the CI smoke and
+       tests/test_paged_attention.py assert).
+    """
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        engine_spec,
+        hbm_tick_costs,
+    )
+    from simple_distributed_machine_learning_tpu.serve import (
+        InferenceEngine,
+    )
+    from simple_distributed_machine_learning_tpu.serve.slots import (
+        kv_block_bytes,
+        n_blocks_for_bytes,
+    )
+
+    dev = {"device_kind": jax.devices()[0].device_kind,
+           "backend": jax.default_backend()}
+    rng = np.random.default_rng(11)
+
+    def _specs(n, seed0=0):
+        return [dict(prompt=rng.integers(
+                         0, cfg.vocab,
+                         prompt_lens[i % len(prompt_lens)]).astype(np.int32),
+                     max_new_tokens=max_new, seed=seed0 + i)
+                for i in range(n)]
+
+    out = []
+    # -- 1. dense vs fused kernel path -------------------------------------
+    streams = {}
+    burst = _specs(n_requests)
+    for kernel in ("dense", "fused"):
+        engine = InferenceEngine(stages, cfg, n_slots=slots,
+                                 block_size=block_size, attn_kernel=kernel)
+        for t0 in prompt_lens:       # warm every compiled shape
+            engine.submit(np.zeros(t0, np.int32), max_new_tokens=2)
+        engine.drain()
+        handles, ticks, toks, _peak, done, wall = _drain_burst(
+            engine, [dict(sp) for sp in burst])
+        streams[kernel] = [list(h.tokens) for h in handles]
+        costs = {h.op: h.bytes_per_tick
+                 for h in hbm_tick_costs(engine_spec(engine),
+                                         n_layers=engine._n_layers)}
+        decode_bytes = (costs["decode.kv_gather"]
+                        + costs.get("decode.kv_attn_reread", 0))
+        out.append({
+            "config": "paged_attention_kernel", "kernel": kernel,
+            "n_slots": slots, "n_requests": n_requests,
+            "completed": done, "ticks": ticks,
+            "ticks_per_sec": round(ticks / wall, 1),
+            "tokens_per_sec": round(toks / wall, 1),
+            "decode_kv_bytes_per_tick": decode_bytes, **dev,
+        })
+    # the bit-exactness anchor, REPORTED rather than raised: on a real
+    # accelerator the kernel's different accumulation order may flip a
+    # genuine near-tie argmax (the tests/tolerances.py budget), and a
+    # measurement round must record that, not abort. Sparse flips within
+    # the near-tie budget report bit_exact false with the fraction; a
+    # wholesale divergence (a real math bug) still fails loudly
+    flat_d = [t for s_ in streams["dense"] for t in s_]
+    flat_f = [t for s_ in streams["fused"] for t in s_]
+    mismatch = (sum(a != b for a, b in zip(flat_d, flat_f))
+                / max(len(flat_d), 1))
+    if mismatch > 0.25:    # pragma: no cover - gate
+        raise AssertionError(
+            f"bench: fused-kernel greedy streams diverged {mismatch:.0%} "
+            f"from the dense path — beyond any near-tie budget, the "
+            f"parity anchor is broken")
+    dense_b = out[-2]["decode_kv_bytes_per_tick"]
+    fused_b = out[-1]["decode_kv_bytes_per_tick"]
+    out[-1]["hbm_reduction"] = round(dense_b / fused_b, 2)
+    out[-1]["streams_bit_exact"] = mismatch == 0
+    if mismatch:           # pragma: no cover - near-tie corner on-chip
+        out[-1]["stream_mismatch_fraction"] = round(mismatch, 4)
+        sys.stderr.write(
+            f"bench: fused streams flipped {mismatch:.2%} of tokens "
+            f"(near-tie argmax under reordered accumulation)\n")
+
+    # -- 2. int8 vs bf16 resident requests at fixed KV bytes ---------------
+    L = sum(len(p["blocks"]) for p in (s.params for s in stages))
+    dh = cfg.d_model // cfg.n_heads
+    # cap the pools' per-sequence budget at the workload's footprint (the
+    # pool refuses a capacity that cannot hold one full sequence, and the
+    # comparison is about RESIDENT REQUESTS, not unreachable headroom)
+    ml_q = max(prompt_lens) + max_new
+    bpr = -(-ml_q // block_size)         # == the pools' blocks_per_seq
+    # a realistic non-divisible budget: 2 requests' worth of bf16 blocks
+    # plus one stranded block (fixed budgets never divide evenly)
+    budget = (2 * bpr + 1) * kv_block_bytes(L, cfg.n_heads, block_size, dh,
+                                            "bfloat16")
+    base_concurrent = None
+    for cd in ("bfloat16", "int8"):
+        nb = n_blocks_for_bytes(budget, L, cfg.n_heads, block_size, dh, cd)
+        n_slots_q = min(32, max(2, nb // bpr + 1))
+        engine = InferenceEngine(stages, cfg, n_slots=n_slots_q,
+                                 max_len=ml_q, block_size=block_size,
+                                 n_blocks=nb, cache_dtype=cd)
+        for t0 in prompt_lens:
+            engine.submit(np.zeros(t0, np.int32), max_new_tokens=2)
+        engine.drain()
+        # every request the longest shape: the budget maths above sized
+        # the pool for exactly this per-request footprint
+        specs = [dict(prompt=rng.integers(0, cfg.vocab,
+                                          max(prompt_lens)).astype(np.int32),
+                      max_new_tokens=max_new, seed=700 + i)
+                 for i in range(max(n_requests, 3 * n_slots_q))]
+        _h, _ticks, toks, peak, done, wall = _drain_burst(engine, specs)
+        row = {
+            "config": "gpt_serve_quantized_fixed_mem", "cache_dtype": cd,
+            "kv_budget_bytes": int(budget), "n_blocks": nb,
+            "n_slots": n_slots_q, "bytes_per_block": engine.pool.
+            bytes_per_block, "n_requests": len(specs), "completed": done,
+            "max_concurrent": peak,
+            "tokens_per_sec": round(toks / wall, 1), **dev,
+        }
+        if base_concurrent is None:
+            base_concurrent = peak
+        else:
+            row["resident_ratio"] = round(peak / base_concurrent, 2)
+        out.append(row)
     return out
 
 
@@ -1376,6 +1556,12 @@ def main() -> None:
                          "batching tokens/sec + TTFT/TPOT p50/p95 per "
                          "Poisson arrival rate, vs the 1-slot sequential "
                          "baseline; writes benchmarks/serving.json")
+    ap.add_argument("--serve-kernel", choices=("dense", "fused"),
+                    default="dense",
+                    help="with --serve: the sweep engines' paged-attention "
+                         "path — dense gather-then-dense (parity anchor) "
+                         "or the fused Pallas flash-decode kernel; the "
+                         "kernel comparison rows always measure both")
     ap.add_argument("--opt", choices=("sgd", "adamw"), default=None,
                     help="override the per-config optimizer (experiment "
                          "rows only; results_all.json is not rewritten "
@@ -1527,7 +1713,8 @@ def main() -> None:
         if not names and not args.serve:
             return
     if args.serve:
-        for srow in measure_serving(lint=args.lint):
+        for srow in measure_serving(lint=args.lint,
+                                    attn_kernel=args.serve_kernel):
             line = {"metric": srow["config"], "n_slots": srow["n_slots"]}
             # sweep rows report throughput+latency; the paged-vs-dense
             # comparison rows report concurrency / tick-latency instead
@@ -1538,7 +1725,10 @@ def main() -> None:
                       "tick_ms_max", "tp", "spec_k", "accept_rate",
                       "tokens_per_tick_spec", "tokens_per_tick_plain",
                       "speedup_vs_plain", "wall_tokens_per_sec_spec",
-                      "wall_tokens_per_sec_plain"):
+                      "wall_tokens_per_sec_plain", "kernel",
+                      "ticks_per_sec", "decode_kv_bytes_per_tick",
+                      "hbm_reduction", "streams_bit_exact", "cache_dtype",
+                      "kv_budget_bytes", "n_blocks", "resident_ratio"):
                 if srow.get(k) is not None:
                     line[k] = srow[k]
             print(json.dumps(line))

@@ -103,6 +103,11 @@ class ServeSpec:
     prompt_lens: tuple | None = None
     spec_k: int = 0                     # 0 -> plain decode (no draft)
     draft_cfg: Any = None
+    # the decode/verify attention path: "dense" gather-then-dense (two
+    # passes over resident K/V per tick) or "fused" (the Pallas
+    # paged-attention kernel's single pass) — the HBM model's per-tick
+    # rows and the registry's built programs both key off it
+    attn_kernel: str = "dense"
 
     @property
     def tp(self) -> int:
@@ -199,6 +204,23 @@ def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
 
+def _cache_sds(shape, cache_dtype):
+    """Abstract pool buffer for ``shape`` under ``cache_dtype``: a plain
+    struct, or the QuantKV (data + per-row scale plane) pytree a
+    quantized pool actually threads through every tick program."""
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        QuantKV,
+        _cache_dtype,
+        _is_quantized_dtype,
+    )
+    if _is_quantized_dtype(cache_dtype):
+        return QuantKV(_sds(shape, _cache_dtype(cache_dtype)),
+                       _sds(shape[:-1], np.float32))
+    return _sds(shape, _cache_dtype(cache_dtype))
+
+
 def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
                    ) -> tuple[list[Program], list[Finding]]:
     """Build every compiled program of ``sspec``'s serve path with its
@@ -258,16 +280,23 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
     t0 = int(min(sspec.prompt_lens)) if sspec.prompt_lens else min(4, ml - 1)
     t0 = max(1, min(t0, ml - 1))
     n_new = ml - t0
+    # the solo anchor decodes dense rows: a quantized serving dtype
+    # widens to f32 there (quantized pools are judged against it at
+    # pinned tolerance, not bit-exactness)
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        _is_quantized_dtype as _is_q,
+    )
+    anchor_cd = None if _is_q(sspec.cache_dtype) else sspec.cache_dtype
     findings += check_builder_memo(
         "make_cached_decoder",
         lambda: make_cached_decoder(stages, cfg_dense(cfg), t0, n_new,
-                                    cache_dtype=sspec.cache_dtype))
+                                    cache_dtype=anchor_cd))
     findings += _retrace_finding("make_cached_decoder",
                                  "(prompt_len, n_new) pair", sspec)
     programs.append(Program(
         "cached_decoder",
         make_cached_decoder(stages, cfg_dense(cfg), t0, n_new,
-                            cache_dtype=sspec.cache_dtype),
+                            cache_dtype=anchor_cd),
         (abstractify(dense_params), spec((1, t0), np.int32, 0, V - 1),
          _key_sds())))
 
@@ -281,18 +310,22 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
         """The draft propose scan + its abstract pool (dense slot layout
         whatever the target layout — the engine's draft discipline)."""
         from simple_distributed_machine_learning_tpu.models.gpt import (
+            _cache_dtype,
+            _is_quantized_dtype,
             make_slot_propose,
         )
         dcfg = sspec.draft_cfg
         dL = sum(len(p["blocks"]) for p in (s.params for s in draft_stages))
+        # dense draft rows: a quantized TARGET dtype falls back to f32 for
+        # the draft (the engine's rule — trace the program it actually runs)
+        draft_cd = (None if _is_quantized_dtype(sspec.cache_dtype)
+                    else sspec.cache_dtype)
         dkc = _sds((dL, S, dcfg.n_heads, ml,
-                    dcfg.d_model // dcfg.n_heads), cd)
-        propose = make_slot_propose(draft_stages, dcfg, ml, K,
-                                    sspec.cache_dtype)
+                    dcfg.d_model // dcfg.n_heads), _cache_dtype(draft_cd))
+        propose = make_slot_propose(draft_stages, dcfg, ml, K, draft_cd)
         memo = check_builder_memo(
             "make_slot_propose",
-            lambda: make_slot_propose(draft_stages, dcfg, ml, K,
-                                      sspec.cache_dtype))
+            lambda: make_slot_propose(draft_stages, dcfg, ml, K, draft_cd))
         dparams = abstractify([s.params for s in draft_stages])
         propose_args = (dparams, dkc, dkc, toks, pos, kdS, f32S, top_ks,
                         f32S)
@@ -393,14 +426,16 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
         return programs, findings
 
     # paged layout
-    kc = _sds((L, n_blocks + 1, H, bs, dh), cd)
+    kc = _cache_sds((L, n_blocks + 1, H, bs, dh), sspec.cache_dtype)
+    kernel = sspec.attn_kernel
     tables = spec((S, NB), np.int32, 0, n_blocks)
     table1 = spec((NB,), np.int32, 0, n_blocks)
     c = sspec.resolved_chunk
     chunk = make_paged_prefill_chunk(stages, cfg, ml, bs,
                                      sspec.cache_dtype, mesh=mesh)
     decode = make_paged_decode_step(stages, cfg, ml, bs,
-                                    sspec.cache_dtype, mesh=mesh)
+                                    sspec.cache_dtype, mesh=mesh,
+                                    kernel=kernel)
     copy = make_paged_block_copy()
     findings += check_builder_memo(
         "make_paged_prefill_chunk",
@@ -409,7 +444,8 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
     findings += check_builder_memo(
         "make_paged_decode_step",
         lambda: make_paged_decode_step(stages, cfg, ml, bs,
-                                       sspec.cache_dtype, mesh=mesh))
+                                       sspec.cache_dtype, mesh=mesh,
+                                       kernel=kernel))
     findings += check_builder_memo("make_paged_block_copy",
                                    make_paged_block_copy)
     if sspec.prefill_chunk is None:
@@ -452,11 +488,13 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
         propose, propose_args, memo = _spec_draft_programs()
         findings += memo
         verify = make_paged_verify_step(stages, cfg, ml, bs, K,
-                                        sspec.cache_dtype, mesh=mesh)
+                                        sspec.cache_dtype, mesh=mesh,
+                                        kernel=kernel)
         findings += check_builder_memo(
             "make_paged_verify_step",
             lambda: make_paged_verify_step(stages, cfg, ml, bs, K,
-                                           sspec.cache_dtype, mesh=mesh))
+                                           sspec.cache_dtype, mesh=mesh,
+                                           kernel=kernel))
         verify_args = (params, kc, kc, toks, pos, drafts_a, qrows_a,
                        valid_n, tables, kdS, f32S, top_ks, f32S)
         programs.append(Program("paged_propose", propose, propose_args))
@@ -472,12 +510,13 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
             dcfg = sspec.draft_cfg
             paged_spec_tick = make_paged_spec_tick(
                 stages, cfg, draft_stages, dcfg, ml, bs, K,
-                sspec.cache_dtype)
+                sspec.cache_dtype, kernel=kernel)
             findings += check_builder_memo(
                 "make_paged_spec_tick",
                 lambda: make_paged_spec_tick(stages, cfg, draft_stages,
                                              dcfg, ml, bs, K,
-                                             sspec.cache_dtype))
+                                             sspec.cache_dtype,
+                                             kernel=kernel))
         else:
             def paged_spec_tick(dparams, dkc, dvc, params, kc, vc, toks,
                                 pos, valid, tables, dkds, kds, temps, tks,
@@ -512,9 +551,17 @@ def degraded_spec(sspec: ServeSpec) -> ServeSpec:
     registry sweep (:func:`default_registry_reports`) lints the exact
     layout a chaos-stressed supervisor will rebuild into — a fallback that
     only exists on the worst day must be proven clean on every PR."""
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        _is_quantized_dtype,
+    )
     return ServeSpec(cfg_dense(sspec.cfg), n_slots=sspec.n_slots,
                      max_len=sspec.max_len, kv_layout="dense",
-                     cache_dtype=sspec.cache_dtype,
+                     # quantized blocks and the fused kernel are paged
+                     # features: the dense fallback widens to f32 and
+                     # dense-math attention (engine_factory's rule)
+                     cache_dtype=(None
+                                  if _is_quantized_dtype(sspec.cache_dtype)
+                                  else sspec.cache_dtype),
                      prompt_lens=sspec.prompt_lens)
 
 
@@ -529,29 +576,41 @@ def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
     stream sizes depend on block geometry and slot count only; what
     occupancy changes is the RESIDENT bytes
     (:func:`predict_kv_bytes_resident`)."""
-    import numpy as np
-
-    from simple_distributed_machine_learning_tpu.models.gpt import (
-        _cache_dtype,
+    from simple_distributed_machine_learning_tpu.serve.slots import (
+        kv_block_bytes,
     )
     cfg = sspec.cfg
     L = n_layers if n_layers is not None else cfg.n_layers
     H, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
-    isz = np.dtype(_cache_dtype(sspec.cache_dtype)).itemsize
     S, ml = sspec.n_slots, sspec.ml
     tp = sspec.tp
     # K + V, one position, 1 layer — PER SHARD (the TP serving programs
-    # split the head axis tp ways, so each chip streams H/tp heads; this
-    # is the same per-shard rule the pool's bytes_per_block uses)
-    row = 2 * (H // tp) * dh * isz
+    # split the head axis tp ways, so each chip streams H/tp heads).
+    # Derived from kv_block_bytes so it IS the pool's bytes_per_block per
+    # row — which makes quantized caches automatic: int8/fp8 data plus the
+    # per-row f32 scale planes the kernel (and the dense-path dequant
+    # gather) actually stream
+    row = kv_block_bytes(1, H // tp, 1, dh, sspec.cache_dtype)
     shard = f" (per {tp}-way shard)" if tp > 1 else ""
+    fused = sspec.attn_kernel == "fused"
     out: list[HBMCost] = []
     K = int(sspec.spec_k)
     if sspec.kv_layout == "paged":
         span = sspec.blocks_per_seq * sspec.block_size
         out.append(HBMCost(
             "decode.kv_gather", "paged_decode", S * L * span * row,
-            note=f"{S} slots x {L} layers x {span}-row table span{shard}"))
+            note=f"{S} slots x {L} layers x {span}-row table span{shard}"
+                 + (" — the fused kernel's single pass" if fused else "")))
+        if not fused:
+            # gather-then-dense materializes the gathered span and the
+            # attention einsums read it back: a SECOND full pass of
+            # resident K/V per tick — exactly what kernel='fused'
+            # (ops/paged_attention.py) eliminates
+            out.append(HBMCost(
+                "decode.kv_attn_reread", "paged_decode",
+                S * L * span * row,
+                note=f"dense-math path rereads the materialized "
+                     f"span{shard}; eliminated by kernel='fused'"))
         out.append(HBMCost(
             "decode.kv_scatter", "paged_decode", S * L * row,
             note=f"one position per slot per layer{shard}"))
@@ -572,7 +631,15 @@ def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
                 note=f"{K} speculated positions per slot per layer{shard}"))
             out.append(HBMCost(
                 "verify.kv_gather", "paged_verify", S * L * span * row,
-                note=f"the verify queries attend the table span{shard}"))
+                note=f"the verify queries attend the table span{shard}"
+                     + (" — the fused kernel's single pass" if fused
+                        else "")))
+            if not fused:
+                out.append(HBMCost(
+                    "verify.kv_attn_reread", "paged_verify",
+                    S * L * span * row,
+                    note=f"dense-math path rereads the materialized "
+                         f"span{shard}; eliminated by kernel='fused'"))
     else:
         out.append(HBMCost(
             "decode.kv_read", "slot_decode", S * L * ml * row,
@@ -588,8 +655,16 @@ def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
                 "verify.kv_read", "slot_verify", S * L * ml * row,
                 note=f"the verify queries read the full rows{shard}"))
     if K >= 2 and sspec.draft_cfg is not None:
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            _is_quantized_dtype,
+        )
         dcfg = sspec.draft_cfg
-        drow = 2 * dcfg.n_heads * (dcfg.d_model // dcfg.n_heads) * isz
+        # the draft pool is dense slot rows; a quantized TARGET dtype
+        # falls back to f32 for the draft (the engine's rule)
+        draft_cd = (None if _is_quantized_dtype(sspec.cache_dtype)
+                    else sspec.cache_dtype)
+        drow = kv_block_bytes(1, dcfg.n_heads, 1,
+                              dcfg.d_model // dcfg.n_heads, draft_cd)
         dL = dcfg.n_layers
         out.append(HBMCost(
             "propose.kv_read", "slot_propose", K * S * dL * ml * drow,
@@ -635,6 +710,11 @@ def predict_kv_bytes_resident(sspec: ServeSpec, rows_per_seq,
 
 # -- the one-call preflights -----------------------------------------------
 
+def jnp_dtype_name(cache_dtype) -> str:
+    import jax.numpy as jnp
+    return jnp.dtype(cache_dtype).name
+
+
 def _injected_findings() -> list[Finding]:
     tag = os.environ.get("SDML_LINT_INJECT")
     if not tag:
@@ -670,6 +750,10 @@ def lint_serve(stages, sspec: ServeSpec, name: str | None = None,
                      + (f" block={sspec.block_size}"
                         f" chunk={sspec.prefill_chunk}"
                         if sspec.kv_layout == "paged" else "")
+                     + (" kernel=fused" if sspec.attn_kernel == "fused"
+                        else "")
+                     + (f" cache={jnp_dtype_name(sspec.cache_dtype)}"
+                        if sspec.cache_dtype is not None else "")
                      + (f" tp={sspec.tp}" if sspec.tp > 1 else "")
                      + (f" spec_k={sspec.spec_k}" if sspec.spec_k
                         else "") + "]")
@@ -713,6 +797,12 @@ def default_registry_reports() -> list[Report]:
                   prefill_chunk=3, prompt_lens=buckets),
         ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=8,
                   prefill_chunk=None, prompt_lens=buckets),
+        # the fused Pallas paged-attention kernel over an int8-quantized
+        # pool (interpret mode off-TPU): the serving hot path's kernel
+        # variant is linted exactly like the dense-math programs
+        ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
+                  prefill_chunk=3, prompt_lens=buckets,
+                  cache_dtype="int8", attn_kernel="fused"),
         ServeSpec(cfg, n_slots=4, kv_layout="dense", prompt_lens=buckets),
         # the speculative pair (draft propose + batched verify + composite
         # tick) on both layouts — TP deployments need a live multi-device
@@ -748,9 +838,12 @@ def engine_spec(engine, prompt_lens: tuple | None = None) -> ServeSpec:
         block_size=pool.block_size if paged else 16,
         n_blocks=pool.n_blocks if paged else None,
         prefill_chunk=engine.prefill_chunk,
+        # pool.kc.dtype covers QuantKV too (its dtype property is the
+        # narrow storage dtype, which round-trips through _cache_dtype)
         cache_dtype=pool.kc.dtype, prompt_lens=prompt_lens,
         spec_k=engine.spec_k if engine.speculative else 0,
-        draft_cfg=engine.draft_cfg)
+        draft_cfg=engine.draft_cfg,
+        attn_kernel=engine.attn_kernel)
 
 
 def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:

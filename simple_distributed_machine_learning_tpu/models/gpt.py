@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -606,8 +607,96 @@ def _cache_dtype(cache_dtype):
     cache is the dominant inference allocation at L x B x H x total x dh x 2
     buffers — at ~1e-3 relative logit error (attention math still
     accumulates in f32 via einsum promotion). The one copy of the rule for
-    every decoder (cached, beam, pipeline-parallel)."""
+    every decoder (cached, beam, pipeline-parallel).
+
+    QUANTIZED storage (``int8``, and the fp8 formats where the jnp build
+    has them) quarters/halves-again the paged pool's block bytes: blocks
+    store narrow-dtype rows plus one f32 scale per (position, head) row —
+    a :class:`QuantKV` pytree instead of a bare array — with quantize
+    fused into every scatter and dequantize into every gather/kernel
+    (:func:`_quantize_rows` / :func:`_paged_gather`). Quantization is a
+    PAGED-pool feature: dense slot pools and the solo cached decoder are
+    the parity anchors and reject it (:func:`_check_cache_quantization`)."""
     return jnp.float32 if cache_dtype is None else jnp.dtype(cache_dtype)
+
+
+# fp8 availability is build-dependent on the 0.4.x line; int8 always exists
+_QUANT_QMAX = {"int8": 127.0}
+for _fp8_name, _fp8_qmax in (("float8_e4m3fn", 448.0),
+                             ("float8_e5m2", 57344.0)):
+    if hasattr(jnp, _fp8_name):
+        _QUANT_QMAX[_fp8_name] = _fp8_qmax
+
+
+def _is_quantized_dtype(cache_dtype) -> bool:
+    """Whether ``cache_dtype`` selects the quantized (data + scales) K/V
+    block format — the one predicate pool construction, byte accounting
+    and program tracing all branch on."""
+    return (cache_dtype is not None
+            and jnp.dtype(cache_dtype).name in _QUANT_QMAX)
+
+
+class QuantKV(NamedTuple):
+    """One quantized K or V pool buffer: narrow-dtype block ``data``
+    (``[L, n_blocks+1, H, bs, dh]``) plus the per-row f32 dequant
+    ``scale`` plane (``[L, n_blocks+1, H, bs]`` — one scale per written
+    position per head, so incremental decode writes never re-quantize a
+    block's existing rows). A NamedTuple so jax treats the pair as ONE
+    pytree buffer: jit donation, device_put sharding and tree_map'd block
+    copies all flow through unchanged engine/pool code."""
+    data: jax.Array
+    scale: jax.Array
+
+    @property
+    def dtype(self):
+        """The storage dtype — what ``engine_spec``/``ServeSpec`` record
+        as the deployment's cache_dtype."""
+        return self.data.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.scale.nbytes
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+
+def _quantize_rows(rows: jax.Array, dtype) -> tuple[jax.Array, jax.Array]:
+    """Quantize K/V rows ``[..., dh]`` to ``dtype`` with one f32 scale per
+    row: ``scale = amax(|row|) / qmax`` (floored so all-zero rows stay
+    finite), data = ``round(row / scale)`` for int8, the plain cast for
+    fp8 (whose format rounds itself). Dequantization is exactly
+    ``data * scale`` — the round trip's relative error is bounded by
+    ~``1/(2*qmax)`` per element (tests/test_paged_attention.py pins it)."""
+    dtype = jnp.dtype(dtype)
+    qmax = _QUANT_QMAX[dtype.name]
+    rows = rows.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(rows), axis=-1) / qmax, 1e-8)
+    q = rows / scale[..., None]
+    if dtype.name == "int8":
+        q = jnp.clip(jnp.round(q), -127.0, 127.0)
+    return q.astype(dtype), scale.astype(jnp.float32)
+
+
+def _check_cache_quantization(cache_dtype, caller: str,
+                              paged: bool) -> None:
+    """Quantized caches are paged-pool-only (the dense layouts are the
+    bit-exactness anchors the quantized pool's pinned tolerance is judged
+    against); unknown narrow dtypes fail loudly here instead of as a
+    shape error mid-trace."""
+    if cache_dtype is None:
+        return
+    name = jnp.dtype(cache_dtype).name
+    if name in ("float8_e4m3fn", "float8_e5m2") and name not in _QUANT_QMAX:
+        raise ValueError(
+            f"{caller}: cache_dtype={name} is not available in this jnp "
+            f"build — use int8 (always available) or a wider dtype")
+    if _is_quantized_dtype(cache_dtype) and not paged:
+        raise ValueError(
+            f"{caller}: quantized cache_dtype={name} is a paged-pool "
+            f"feature (per-block scales live beside physical blocks); "
+            f"dense slot layouts are the parity anchors — use f32/bf16")
 
 
 # -- tensor-parallel serving ------------------------------------------------
@@ -1013,6 +1102,8 @@ def make_cached_decoder(stages, cfg: GPTConfig, prompt_len: int, n_new: int,
             "cached decode is single-device; rebuild the stages with n_seq=1 "
             "(same weights) as make_decoder requires too")
     _check_sampling_args(temperature, top_k, top_p, cfg.vocab)
+    _check_cache_quantization(cache_dtype, "make_cached_decoder",
+                              paged=False)
     total = _validate_decode_build(stages, cfg, prompt_len, n_new,
                                    "make_cached_decoder")
     H, d = cfg.n_heads, cfg.d_model
@@ -1079,12 +1170,14 @@ def _build_cached_decoder(total, prompt_len, n_new, H, dh, cd,
 
 
 def _validate_slot_build(stages, cfg: GPTConfig, max_len: int,
-                         caller: str) -> None:
+                         caller: str, cache_dtype=None) -> None:
     """Shared validation for the serving slot ops: single-device dense-MLP
     builds only (the :func:`make_cached_decoder` restrictions — MoE routing
     capacity is a full-sequence quantity; sharded stage trees are per-shard
-    slices, not the whole model), and ``max_len`` within the position
-    table."""
+    slices, not the whole model), ``max_len`` within the position
+    table, and no quantized cache dtype (dense slot rows are the parity
+    anchors; the paged validator re-allows quantization)."""
+    _check_cache_quantization(cache_dtype, caller, paged=False)
     if cfg.n_experts > 0:
         raise ValueError(
             f"{caller} supports dense-MLP blocks only — MoE capacity is a "
@@ -1132,7 +1225,8 @@ def make_slot_prefill(stages, cfg: GPTConfig, max_len: int,
     of :func:`_tp_attn_tail` — with ``params`` in the
     :func:`pack_tp_serve_params` layout.
     """
-    _validate_slot_build(stages, cfg, max_len, "make_slot_prefill")
+    _validate_slot_build(stages, cfg, max_len, "make_slot_prefill",
+                         cache_dtype)
     mesh = _validate_tp_serve(cfg, mesh, "make_slot_prefill")
     H = cfg.n_heads
     key_ = ("slot_prefill", cfg, max_len, mesh)
@@ -1243,7 +1337,8 @@ def make_slot_decode_step(stages, cfg: GPTConfig, max_len: int,
     twin over the head-sharded pool (:func:`make_slot_prefill`'s TP notes
     apply).
     """
-    _validate_slot_build(stages, cfg, max_len, "make_slot_decode_step")
+    _validate_slot_build(stages, cfg, max_len, "make_slot_decode_step",
+                         cache_dtype)
     mesh = _validate_tp_serve(cfg, mesh, "make_slot_decode_step")
     H = cfg.n_heads
     key_ = ("slot_decode", cfg, max_len, mesh)
@@ -1294,9 +1389,13 @@ def _build_slot_decode_tp(cfg, mesh):
 
 
 def _validate_paged_build(stages, cfg: GPTConfig, max_len: int,
-                          block_size: int, caller: str) -> None:
-    """Paged-op validation: the slot-op restrictions plus a sane block."""
+                          block_size: int, caller: str,
+                          cache_dtype=None) -> None:
+    """Paged-op validation: the slot-op restrictions plus a sane block.
+    Quantized cache dtypes are allowed HERE (the paged pool carries the
+    per-block scale planes) — only their availability is checked."""
     _validate_slot_build(stages, cfg, max_len, caller)
+    _check_cache_quantization(cache_dtype, caller, paged=True)
     if block_size < 1:
         raise ValueError(f"{caller} needs block_size >= 1, got {block_size}")
 
@@ -1316,6 +1415,58 @@ def _gather_paged_rows(cache_l: jax.Array, table: jax.Array) -> jax.Array:
     rows = jnp.moveaxis(rows, -4, -3)         # [..., H, NB, bs, dh]
     return rows.reshape(*rows.shape[:-3],
                         rows.shape[-3] * rows.shape[-2], rows.shape[-1])
+
+
+def _paged_scatter(kc, li, phys, off, rows):
+    """Land K/V ``rows`` (``[..., H, dh]``, aligned with the ``phys``/
+    ``off`` index arrays ``[...]``) at layer ``li`` of a paged pool buffer
+    — the ONE scatter every paged program uses. Plain buffers cast to the
+    storage dtype; :class:`QuantKV` buffers quantize each row and land its
+    scale in the matching plane, so a quantized pool never holds a
+    half-updated (data, scale) pair."""
+    if isinstance(kc, QuantKV):
+        qd, sc = _quantize_rows(rows, kc.data.dtype)
+        return QuantKV(kc.data.at[li, phys, :, off, :].set(qd),
+                       kc.scale.at[li, phys, :, off].set(sc))
+    return kc.at[li, phys, :, off, :].set(rows.astype(kc.dtype))
+
+
+def _paged_gather(kc, li, table):
+    """Layer ``li``'s gathered sequence rows (``[..., H, span, dh]``) for
+    the dense-math attention path; :class:`QuantKV` buffers dequantize
+    (``data * scale``, f32) so the downstream einsums see ordinary rows."""
+    if isinstance(kc, QuantKV):
+        rows = _gather_paged_rows(kc.data[li], table).astype(jnp.float32)
+        sc = kc.scale[li][table]              # [..., NB, H, bs]
+        sc = jnp.moveaxis(sc, -3, -2)         # [..., H, NB, bs]
+        sc = sc.reshape(*sc.shape[:-2], sc.shape[-2] * sc.shape[-1])
+        return rows * sc[..., None]
+    return _gather_paged_rows(kc[li], table)
+
+
+def _paged_attend(kc, vc, li, q, tables, qpos, bs):
+    """The FUSED attention path: one Pallas pass over layer ``li``'s
+    physical blocks (gather + mask + online-softmax attention, dequant
+    fused for :class:`QuantKV` pools) — see ``ops/paged_attention.py``.
+    ``q``: [S, H, K, dh]; ``qpos``: [S, K]. Returns f32 [S, H, K, dh],
+    exactly the dense-math path's masked attention output."""
+    from simple_distributed_machine_learning_tpu.ops.paged_attention import (
+        paged_attention,
+    )
+    if isinstance(kc, QuantKV):
+        return paged_attention(q, kc.data[li], vc.data[li], tables, qpos,
+                               block_size=bs, kscale=kc.scale[li],
+                               vscale=vc.scale[li])
+    return paged_attention(q, kc[li], vc[li], tables, qpos, block_size=bs)
+
+
+def _check_attn_kernel(kernel: str, caller: str) -> str:
+    if kernel not in ("dense", "fused"):
+        raise ValueError(
+            f"{caller}: kernel must be 'dense' (gather-then-dense "
+            f"attention, the parity anchor) or 'fused' (the Pallas "
+            f"paged-attention kernel), got {kernel!r}")
+    return kernel
 
 
 def make_paged_prefill_chunk(stages, cfg: GPTConfig, max_len: int,
@@ -1350,7 +1501,7 @@ def make_paged_prefill_chunk(stages, cfg: GPTConfig, max_len: int,
     the engine always threads the returned buffers back into the pool.
     """
     _validate_paged_build(stages, cfg, max_len, block_size,
-                          "make_paged_prefill_chunk")
+                          "make_paged_prefill_chunk", cache_dtype)
     mesh = _validate_tp_serve(cfg, mesh, "make_paged_prefill_chunk")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
@@ -1376,12 +1527,10 @@ def _paged_chunk_fwd(blocks, embed, head, kc, vc, tokens, p0, table, H, bs,
     live = (jnp.arange(span)[None, :] <= idx[:, None])[None, None]
     for li, bp in enumerate(blocks):
         q, k_, v = _dense_qkv(bp, h, H)           # [1, H, c, dh]
-        kc = kc.at[li, phys, :, off, :].set(
-            k_[0].swapaxes(0, 1).astype(kc.dtype))
-        vc = vc.at[li, phys, :, off, :].set(
-            v[0].swapaxes(0, 1).astype(vc.dtype))
-        krow = _gather_paged_rows(kc[li], table)  # [H, span, dh]
-        vrow = _gather_paged_rows(vc[li], table)
+        kc = _paged_scatter(kc, li, phys, off, k_[0].swapaxes(0, 1))
+        vc = _paged_scatter(vc, li, phys, off, v[0].swapaxes(0, 1))
+        krow = _paged_gather(kc, li, table)       # [H, span, dh]
+        vrow = _paged_gather(vc, li, table)
         scores = jnp.einsum("bhqd,hkd->bhqk", q, krow) / math.sqrt(dh)
         scores = jnp.where(live, scores, -jnp.inf)
         a = jnp.einsum("bhqk,hkd->bhqd",
@@ -1423,7 +1572,8 @@ def _build_paged_prefill_chunk_tp(cfg, bs, dh, mesh):
 
 
 def make_paged_decode_step(stages, cfg: GPTConfig, max_len: int,
-                           block_size: int, cache_dtype=None, mesh=None):
+                           block_size: int, cache_dtype=None, mesh=None,
+                           kernel: str = "dense"):
     """Paged serving decode tick: ``step(params, kc, vc, toks [S], pos [S],
     tables [S, NB], key_data [S, 2], temps [S], top_ks [S], top_ps [S]) ->
     (kc, vc, next_toks [S], next_key_data [S, 2])``.
@@ -1447,23 +1597,38 @@ def make_paged_decode_step(stages, cfg: GPTConfig, max_len: int,
     With ``cfg.n_tensor_parallel > 1`` (pass the ``mesh``): the shard_map
     twin over the head-sharded block pool (:func:`make_slot_prefill`'s TP
     notes apply — block tables and positions stay replicated host inputs).
+
+    ``kernel="fused"`` swaps the gather-then-dense attention for the
+    single-pass Pallas paged-attention kernel (flash-decode layout,
+    ``ops/paged_attention.py``): one HBM read of resident K/V per tick
+    instead of read-materialize-reread. Greedy token streams are
+    bit-exact vs ``kernel="dense"`` (logits to accumulation-order ulps);
+    quantized pools dequantize inside the kernel.
     """
     _validate_paged_build(stages, cfg, max_len, block_size,
-                          "make_paged_decode_step")
+                          "make_paged_decode_step", cache_dtype)
     mesh = _validate_tp_serve(cfg, mesh, "make_paged_decode_step")
+    _check_attn_kernel(kernel, "make_paged_decode_step")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
-    key_ = ("paged_decode", cfg, max_len, block_size, mesh)
+    key_ = ("paged_decode", cfg, max_len, block_size, mesh, kernel)
     if cfg.n_tensor_parallel > 1:
         return _memo_build(key_, lambda: _build_paged_decode_step_tp(
-            cfg, bs, dh, mesh))
-    return _memo_build(key_, lambda: _build_paged_decode_step(H, bs, dh))
+            cfg, bs, dh, mesh, kernel))
+    return _memo_build(key_, lambda: _build_paged_decode_step(H, bs, dh,
+                                                              kernel))
 
 
 def _paged_decode_fwd(blocks, embed, head, kc, vc, toks, pos, tables, H, bs,
-                      dh, tail):
+                      dh, tail, kernel="dense"):
     """The batched one-token-per-slot block-gather step's forward — shared
-    by the single-device and TP paged decode builds."""
+    by the single-device and TP paged decode builds. ``kernel`` selects the
+    attention path: ``"dense"`` gathers each slot's table span into a
+    dense row buffer and runs masked softmax-attention einsums over it
+    (two passes over resident K/V); ``"fused"`` runs the one-pass Pallas
+    flash-decode kernel (:func:`_paged_attend`). Scatter (and quantize,
+    for :class:`QuantKV` pools) happens before either path attends, so
+    the new token's row is visible at its own position in both."""
     pe = jnp.take(embed["pos"], pos, axis=0)[:, None]     # [S, 1, d]
     h = embedding_lookup(embed["tok"], toks[:, None]) + pe
     phys = jnp.take_along_axis(tables, (pos // bs)[:, None],
@@ -1474,28 +1639,30 @@ def _paged_decode_fwd(blocks, embed, head, kc, vc, toks, pos, tables, H, bs,
             <= pos[:, None, None, None])
     for li, bp in enumerate(blocks):
         q, knew, vnew = _dense_qkv(bp, h, H)              # [S, H, 1, dh]
-        kc = kc.at[li, phys, :, off, :].set(
-            knew[:, :, 0, :].astype(kc.dtype))
-        vc = vc.at[li, phys, :, off, :].set(
-            vnew[:, :, 0, :].astype(vc.dtype))
-        krow = _gather_paged_rows(kc[li], tables)         # [S,H,span,dh]
-        vrow = _gather_paged_rows(vc[li], tables)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, krow) / math.sqrt(dh)
-        scores = jnp.where(live, scores, -jnp.inf)
-        a = jnp.einsum("bhqk,bhkd->bhqd",
-                       jax.nn.softmax(scores, axis=-1), vrow)
+        kc = _paged_scatter(kc, li, phys, off, knew[:, :, 0, :])
+        vc = _paged_scatter(vc, li, phys, off, vnew[:, :, 0, :])
+        if kernel == "fused":
+            a = _paged_attend(kc, vc, li, q, tables, pos[:, None], bs)
+        else:
+            krow = _paged_gather(kc, li, tables)          # [S,H,span,dh]
+            vrow = _paged_gather(vc, li, tables)
+            scores = (jnp.einsum("bhqd,bhkd->bhqk", q, krow)
+                      / math.sqrt(dh))
+            scores = jnp.where(live, scores, -jnp.inf)
+            a = jnp.einsum("bhqk,bhkd->bhqd",
+                           jax.nn.softmax(scores, axis=-1), vrow)
         h = tail(bp, h, a)
     return kc, vc, _head_logprobs(head, h[:, 0])          # rows: [S, V]
 
 
-def _build_paged_decode_step(H, bs, dh):
+def _build_paged_decode_step(H, bs, dh, kernel="dense"):
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def step(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
              top_ps):
         embed, blocks, head = _merged_stage_trees(params)
         kc, vc, rows = _paged_decode_fwd(blocks, embed, head, kc, vc, toks,
                                          pos, tables, H, bs, dh,
-                                         _dense_attn_tail)
+                                         _dense_attn_tail, kernel)
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
         return kc, vc, toks2, kd2
@@ -1503,7 +1670,7 @@ def _build_paged_decode_step(H, bs, dh):
     return step
 
 
-def _build_paged_decode_step_tp(cfg, bs, dh, mesh):
+def _build_paged_decode_step_tp(cfg, bs, dh, mesh, kernel="dense"):
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
     H_loc = cfg.n_heads // cfg.n_tensor_parallel
 
@@ -1511,7 +1678,8 @@ def _build_paged_decode_step_tp(cfg, bs, dh, mesh):
              top_ps):
         blocks, embed, head = _tp_local_trees(params)
         kc, vc, rows = _paged_decode_fwd(blocks, embed, head, kc, vc, toks,
-                                         pos, tables, H_loc, bs, dh, tail)
+                                         pos, tables, H_loc, bs, dh, tail,
+                                         kernel)
         rows = _close_rows(rows)
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
@@ -1526,15 +1694,19 @@ def make_paged_block_copy():
     duplicates one physical block's rows across every layer before a
     divergent write. Buffers are donated so XLA updates the pool in place
     instead of materializing a second pool; ``dst``/``src`` are traced
-    scalars so one compiled program serves every copy."""
+    scalars so one compiled program serves every copy. Tree-mapped over
+    the buffer leaves, so a quantized pool's :class:`QuantKV` pair (block
+    data AND its scale plane, both with the physical-block axis at dim 1)
+    copies atomically — a CoW that moved rows without their scales would
+    silently rescale the destination block."""
     def build():
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def copy(kc, vc, dst, src):
-            ks = jax.lax.dynamic_slice_in_dim(kc, src, 1, 1)
-            vs = jax.lax.dynamic_slice_in_dim(vc, src, 1, 1)
-            kc = jax.lax.dynamic_update_slice_in_dim(kc, ks, dst, 1)
-            vc = jax.lax.dynamic_update_slice_in_dim(vc, vs, dst, 1)
-            return kc, vc
+            def one(buf):
+                blk = jax.lax.dynamic_slice_in_dim(buf, src, 1, 1)
+                return jax.lax.dynamic_update_slice_in_dim(buf, blk, dst, 1)
+
+            return jax.tree.map(one, kc), jax.tree.map(one, vc)
 
         return copy
 
@@ -1700,7 +1872,8 @@ def make_slot_propose(stages, cfg: GPTConfig, max_len: int, spec_k: int,
     draft key stream (greedy proposals consume none of it). The draft runs
     single-device/replicated even under a TP target — it is small by
     design; ``kc``/``vc`` are donated."""
-    _validate_slot_build(stages, cfg, max_len, "make_slot_propose")
+    _validate_slot_build(stages, cfg, max_len, "make_slot_propose",
+                         cache_dtype)
     _check_spec_k(spec_k, "make_slot_propose")
     if cfg.n_tensor_parallel > 1:
         raise ValueError(
@@ -1787,7 +1960,8 @@ def make_slot_verify_step(stages, cfg: GPTConfig, max_len: int, spec_k: int,
     twin — head-sharded QKV/O over the head-sharded pool, rows re-closed
     across the model axis before acceptance, so every shard accepts the
     same prefix."""
-    _validate_slot_build(stages, cfg, max_len, "make_slot_verify_step")
+    _validate_slot_build(stages, cfg, max_len, "make_slot_verify_step",
+                         cache_dtype)
     _check_spec_k(spec_k, "make_slot_verify_step")
     mesh = _validate_tp_serve(cfg, mesh, "make_slot_verify_step")
     H = cfg.n_heads
@@ -1848,10 +2022,13 @@ def _build_slot_verify_tp(cfg, K, ml, mesh):
 
 
 def _paged_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wphys, woff,
-                      tables, H, bs, dh, tail):
+                      tables, H, bs, dh, tail, kernel="dense"):
     """K-tokens-per-slot verify forward over the paged block pool: scatter
     each position's K/V into ``(wphys, woff)`` (the trash block past the
-    budget) and attend the gathered table span, masked per query."""
+    budget) and attend the table span, masked per query — via the
+    gather-then-dense einsums (``kernel="dense"``) or the one-pass Pallas
+    paged-attention kernel's K-token variant (``kernel="fused"``; the
+    per-query mask is the kernel's own ``qpos`` plan)."""
     S, K = xs.shape
     pe = jnp.take(embed["pos"], qpos.reshape(-1),
                   axis=0).reshape(S, K, -1)
@@ -1861,23 +2038,25 @@ def _paged_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wphys, woff,
             <= qpos[:, None, :, None])                       # [S,1,K,span]
     for li, bp in enumerate(blocks):
         q, knew, vnew = _dense_qkv(bp, h, H)                 # [S, H, K, dh]
-        kc = kc.at[li, wphys, :, woff, :].set(
-            knew.swapaxes(1, 2).astype(kc.dtype))
-        vc = vc.at[li, wphys, :, woff, :].set(
-            vnew.swapaxes(1, 2).astype(vc.dtype))
-        krow = _gather_paged_rows(kc[li], tables)            # [S,H,span,dh]
-        vrow = _gather_paged_rows(vc[li], tables)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, krow) / math.sqrt(dh)
-        scores = jnp.where(live, scores, -jnp.inf)
-        a = jnp.einsum("bhqk,bhkd->bhqd",
-                       jax.nn.softmax(scores, axis=-1), vrow)
+        kc = _paged_scatter(kc, li, wphys, woff, knew.swapaxes(1, 2))
+        vc = _paged_scatter(vc, li, wphys, woff, vnew.swapaxes(1, 2))
+        if kernel == "fused":
+            a = _paged_attend(kc, vc, li, q, tables, qpos, bs)
+        else:
+            krow = _paged_gather(kc, li, tables)             # [S,H,span,dh]
+            vrow = _paged_gather(vc, li, tables)
+            scores = (jnp.einsum("bhqd,bhkd->bhqk", q, krow)
+                      / math.sqrt(dh))
+            scores = jnp.where(live, scores, -jnp.inf)
+            a = jnp.einsum("bhqk,bhkd->bhqd",
+                           jax.nn.softmax(scores, axis=-1), vrow)
         h = tail(bp, h, a)
     return kc, vc, _head_logprobs(head, h)                   # [S, K, V]
 
 
 def make_paged_verify_step(stages, cfg: GPTConfig, max_len: int,
                            block_size: int, spec_k: int, cache_dtype=None,
-                           mesh=None):
+                           mesh=None, kernel: str = "dense"):
     """Target verify tick (paged layout): ``verify(params, kc, vc,
     toks [S], pos [S], drafts [S, K], draft_rows [S, K, V],
     valid_n [S], tables [S, NB], key_data [S, 2], temps [S], top_ks [S],
@@ -1891,19 +2070,22 @@ def make_paged_verify_step(stages, cfg: GPTConfig, max_len: int,
     blocks. The engine must have ``ensure_writable``'d positions
     ``pos .. pos+valid_n-1`` first (same contract as the decode tick).
     ``kc``/``vc`` are donated. TP: :func:`make_slot_verify_step`'s notes
-    apply."""
+    apply. ``kernel="fused"`` runs the K-token variant of the Pallas
+    paged-attention kernel instead of gather-then-dense (same greedy
+    bit-exactness contract as :func:`make_paged_decode_step`)."""
     _validate_paged_build(stages, cfg, max_len, block_size,
-                          "make_paged_verify_step")
+                          "make_paged_verify_step", cache_dtype)
     _check_spec_k(spec_k, "make_paged_verify_step")
     mesh = _validate_tp_serve(cfg, mesh, "make_paged_verify_step")
+    _check_attn_kernel(kernel, "make_paged_verify_step")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
-    key_ = ("paged_verify", cfg, max_len, block_size, spec_k, mesh)
+    key_ = ("paged_verify", cfg, max_len, block_size, spec_k, mesh, kernel)
     if cfg.n_tensor_parallel > 1:
         return _memo_build(key_, lambda: _build_paged_verify_step_tp(
-            cfg, spec_k, max_len, bs, dh, mesh))
+            cfg, spec_k, max_len, bs, dh, mesh, kernel))
     return _memo_build(key_, lambda: _build_paged_verify_step(
-        H, spec_k, max_len, bs, dh))
+        H, spec_k, max_len, bs, dh, kernel))
 
 
 def _paged_verify_routing(pos, valid_n, tables, K, bs, ml):
@@ -1919,7 +2101,7 @@ def _paged_verify_routing(pos, valid_n, tables, K, bs, ml):
     return qpos, wphys, woff
 
 
-def _build_paged_verify_step(H, K, ml, bs, dh):
+def _build_paged_verify_step(H, K, ml, bs, dh, kernel="dense"):
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
                tables, key_data, temps, top_ks, top_ps):
@@ -1929,7 +2111,7 @@ def _build_paged_verify_step(H, K, ml, bs, dh):
                                                   bs, ml)
         kc, vc, rows = _paged_verify_fwd(blocks, embed, head, kc, vc, xs,
                                          qpos, wphys, woff, tables, H, bs,
-                                         dh, _dense_attn_tail)
+                                         dh, _dense_attn_tail, kernel)
         toks2, n_acc, kd2 = _spec_accept_rows(
             rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
             top_ps)
@@ -1938,7 +2120,7 @@ def _build_paged_verify_step(H, K, ml, bs, dh):
     return verify
 
 
-def _build_paged_verify_step_tp(cfg, K, ml, bs, dh, mesh):
+def _build_paged_verify_step_tp(cfg, K, ml, bs, dh, mesh, kernel="dense"):
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
     H_loc = cfg.n_heads // cfg.n_tensor_parallel
 
@@ -1950,7 +2132,7 @@ def _build_paged_verify_step_tp(cfg, K, ml, bs, dh, mesh):
                                                   bs, ml)
         kc, vc, rows = _paged_verify_fwd(blocks, embed, head, kc, vc, xs,
                                          qpos, wphys, woff, tables, H_loc,
-                                         bs, dh, tail)
+                                         bs, dh, tail, kernel)
         rows = _close_rows(rows)
         toks2, n_acc, kd2 = _spec_accept_rows(
             rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
@@ -2015,18 +2197,24 @@ def make_slot_spec_tick(stages, cfg: GPTConfig, draft_stages,
 
 def make_paged_spec_tick(stages, cfg: GPTConfig, draft_stages,
                          draft_cfg: GPTConfig, max_len: int,
-                         block_size: int, spec_k: int, cache_dtype=None):
+                         block_size: int, spec_k: int, cache_dtype=None,
+                         kernel: str = "dense"):
     """Paged twin of :func:`make_slot_spec_tick`: ``tick(dparams, dkc,
     dvc, params, kc, vc, toks, pos, valid_n, tables [S, NB], dkd, kd,
     temps, top_ks, top_ps) -> (dkc, dvc, kc, vc, toks [S, K], n_acc [S],
     key_data, draft_key_data)`` — the draft pool stays the dense slot
     layout (the engine's draft discipline), the target side is the
-    block-gather :func:`make_paged_verify_step`."""
+    block-gather :func:`make_paged_verify_step` (``kernel="fused"``
+    routes it through the Pallas paged-attention kernel)."""
     _check_spec_tick_build(cfg, draft_cfg, "make_paged_spec_tick")
+    # the draft pool is dense slot rows: a quantized TARGET dtype falls
+    # back to f32 for the draft (the engine builds its draft buffers with
+    # the same rule)
+    draft_cd = None if _is_quantized_dtype(cache_dtype) else cache_dtype
     propose = make_slot_propose(draft_stages, draft_cfg, max_len, spec_k,
-                                cache_dtype)
+                                draft_cd)
     verify = make_paged_verify_step(stages, cfg, max_len, block_size,
-                                    spec_k, cache_dtype)
+                                    spec_k, cache_dtype, kernel=kernel)
 
     def build():
         @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
@@ -2042,7 +2230,7 @@ def make_paged_spec_tick(stages, cfg: GPTConfig, draft_stages,
         return tick
 
     return _memo_build(("paged_spec_tick", cfg, draft_cfg, max_len,
-                        block_size, spec_k), build)
+                        block_size, spec_k, kernel), build)
 
 
 # The memoized decode-path builders, by name — the single list the

@@ -50,8 +50,28 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30  # finite -inf stand-in: keeps exp/max NaN-free in the kernel
 _LANES = 128     # TPU lane width: head dim is padded to this; l/m scratch width
 
+# jax 0.4.x ships the TPU compiler-params dataclass as TPUCompilerParams
+# (renamed to CompilerParams in the 0.5+ line). Resolve once at import so the
+# kernels build on both series — this name mismatch was exactly what made
+# every flash test ERROR (not fail) on the 0.4.x container even though the
+# interpret-mode fallback below would have run the kernel fine.
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or (
+    getattr(pltpu, "TPUCompilerParams", None) if _HAS_PLTPU else None)
+
+
+def _compiler_params(*dimension_semantics: str):
+    return _COMPILER_PARAMS_CLS(
+        dimension_semantics=tuple(dimension_semantics))
+
 
 def _interpret() -> bool:
+    """Pallas interpret mode unless the DEFAULT backend is a real TPU.
+
+    ``jax.default_backend()`` (not ``jax.devices()`` probing): on containers
+    that bake in a TPU plugin but pin ``JAX_PLATFORMS=cpu`` (this test env),
+    the default backend is authoritative for where the computation will
+    actually run — probing for TPU devices would pick interpret=False and
+    then fail to lower through Mosaic on the CPU path."""
     return jax.default_backend() != "tpu"
 
 
@@ -62,11 +82,25 @@ def _vma_of(*xs) -> frozenset:
     here runs — ``pallas_call`` out_shape structs must declare how outputs
     vary over the manual mesh axes, or tracing fails; the kernel's outputs
     vary exactly as its operands do. Outside shard_map this is the empty
-    set and changes nothing."""
+    set and changes nothing (and on 0.4.x, where no vma type system exists,
+    ``compat.vma_of`` is constant-empty)."""
+    from simple_distributed_machine_learning_tpu.parallel.compat import (
+        vma_of,
+    )
     vma = frozenset()
     for x in xs:
-        vma |= getattr(jax.typeof(x), "vma", None) or frozenset()
+        vma |= vma_of(x)
     return vma
+
+
+def _struct(shape, dtype, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` with the vma declaration where the jax
+    version has one (the check_vma era); plain struct on 0.4.x, whose
+    ``shard_map(check_rep=False)`` route never consults vma at all."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # 0.4.x: no vma type system
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _diag_kv_index(block_q: int, block_k: int):
@@ -177,8 +211,7 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, t_real=t, scale=scale)
     # bh and q-blocks are independent; the k axis carries scratch state
-    compiler_params = pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    compiler_params = _compiler_params("parallel", "parallel", "arbitrary")
 
     # Causal fetch elision (_diag_kv_index): the kernel predicates off
     # compute for k-blocks past the diagonal, but an unclamped index map
@@ -203,9 +236,9 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int):
             pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32, vma=vma),
+            _struct((bh, tq, dp), q.dtype, vma),
+            _struct((bh, 1, tq), jnp.float32, vma),
+            _struct((bh, 1, tq), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, dp), jnp.float32),
@@ -387,8 +420,7 @@ def _flash_bwd(block_q, block_k, res, do):
     # fetch elision as the forward — skipped cells must not cost HBM reads)
     k_spec = pl.BlockSpec((1, block_k, dp_), _diag_kv_index(block_q, block_k))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j))
-    compiler_params = pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    compiler_params = _compiler_params("parallel", "parallel", "arbitrary")
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
@@ -397,7 +429,7 @@ def _flash_bwd(block_q, block_k, res, do):
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
                   row_spec],
         out_specs=pl.BlockSpec((1, block_q, dp_), lambda i, j, kb: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, dp_), q.dtype, vma=vma),
+        out_shape=_struct((bh, tq, dp_), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, dp_), jnp.float32)],
         compiler_params=compiler_params,
         interpret=_interpret(),
@@ -426,8 +458,8 @@ def _flash_bwd(block_q, block_k, res, do):
             pl.BlockSpec((1, block_k, dp_), lambda i, j, qb: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, dp_), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, tk, dp_), v.dtype, vma=vma),
+            _struct((bh, tk, dp_), k.dtype, vma),
+            _struct((bh, tk, dp_), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, dp_), jnp.float32),

@@ -1,0 +1,229 @@
+"""Pallas paged attention: fused block-table gather + flash-style decode.
+
+The serving hot path (``models/gpt.py::_paged_decode_fwd`` and the
+speculative ``_paged_verify_fwd``) historically did the standard two-pass
+dance every tick: gather each slot's physical K/V blocks into a dense
+``[S, H, span, dh]`` row buffer (one full HBM read of resident K/V plus a
+full write of the gathered copy), then dense masked attention over that
+buffer (a second full read). This module fuses the two into ONE Pallas
+kernel pass, following the grid/online-softmax structure of
+``ops/flash_attention.py``:
+
+- **block-table-indexed gather**: the per-slot block table and query
+  positions ride in as scalar-prefetch operands
+  (``pltpu.PrefetchScalarGridSpec``), so the K/V BlockSpec index maps
+  dereference ``tables[s, kb]`` directly — each physical block streams from
+  HBM into VMEM exactly once per tick, already in sequence order, and no
+  gathered dense copy ever exists;
+- **online softmax** (flash style): the k-block grid axis is innermost and
+  carries ``(acc, l, m)`` scratch across iterations, so the ``[K, span]``
+  score matrix is never materialized and VMEM holds O(H·K·dh + H·bs·dh);
+- **past-the-end fetch elision**: k-blocks wholly past the newest query
+  position are predicated off with ``pl.when``, and the index map clamps
+  their block id at the last needed one — an unchanged index between
+  iterations means Mosaic's pipeline issues no HBM copy (the
+  ``_diag_kv_index`` trick from the causal kernel, applied to the
+  position mask instead of the diagonal);
+- **fused dequantization**: int8/fp8 K/V blocks carry per-row (position x
+  head) f32 scales; the kernel multiplies them back in VMEM right after the
+  block load, so a quantized pool pays the narrow dtype's HBM bytes without
+  a separate dequantize pass (the whole point of quantizing: the decode
+  tick is memory-bound on exactly this stream);
+- **f32 score/accumulator math**: K/V tiles are upcast (or dequantized) to
+  f32 before the dots, matching the dense path's einsum promotion — which
+  is what keeps greedy decode through this kernel TOKEN-bit-exact against
+  the gather-then-dense path (logits agree to accumulation-order ulps;
+  tests/test_paged_attention.py pins both).
+
+On non-TPU backends the same kernel runs in Pallas interpret mode
+(``flash_attention._interpret``), so the serving engine's ``kernel="fused"``
+path is exercised hermetically on CPU. One kernel serves both tick shapes:
+the single-query flash-decode tick is the ``K = 1`` case of the K-token
+speculative verify.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from simple_distributed_machine_learning_tpu.ops.flash_attention import (
+    _HAS_PLTPU,
+    _LANES,
+    NEG_INF,
+    _compiler_params,
+    _interpret,
+    _struct,
+    _vma_of,
+    pltpu,
+)
+
+
+def _paged_attn_kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, *rest,
+                       bs: int, n_q: int, scale: float, quant: bool):
+    """One (slot, k-block) grid cell; k-block innermost carries the
+    online-softmax state.
+
+    ``q_ref``: [1, H, K, dh] (this slot's queries, all heads);
+    ``k_ref``/``v_ref``: [1, H, bs, dh] — the PHYSICAL block the index map
+    dereferenced through the slot's table; with ``quant``, ``ks_ref``/
+    ``vs_ref``: [1, H, bs] per-row dequant scales of the same block;
+    ``o_ref``: [1, H, K, dh] f32. Scratch: ``acc`` [H, K, dh] f32 and the
+    lane-broadcast ``l``/``m`` [H, K, _LANES] f32 (flash_attention's
+    scratch idiom)."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_scr, l_scr, m_scr = rest
+    else:
+        o_ref, acc_scr, l_scr, m_scr = rest
+    s_idx = pl.program_id(0)
+    kb = pl.program_id(1)
+    n_kb = pl.num_programs(1)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+
+    # k-blocks wholly past the newest query position contribute nothing —
+    # skip (their fetch is elided by the index-map clamp below)
+    @pl.when(kb * bs <= qpos_ref[s_idx, n_q - 1])
+    def _compute():
+        # per-query positions of this slot (K is static and small)
+        qp = jnp.stack([qpos_ref[s_idx, j] for j in range(n_q)])
+        q = q_ref[0].astype(jnp.float32)                  # [H, K, dh]
+        k = k_ref[0].astype(jnp.float32)                  # [H, bs, dh]
+        v = v_ref[0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0][..., None]
+            v = v * vs_ref[0][..., None]
+        # scores in f32 — the dense path's einsum promotion, so the fused
+        # logits track the gather-then-dense ones to ulps
+        s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,)))) * scale
+        kpos = kb * bs + lax.broadcasted_iota(jnp.int32, (1, n_q, bs), 2)
+        mask = kpos <= qp[None, :, None]                  # [1, K, bs]
+        s = jnp.where(mask, s, NEG_INF)                   # [H, K, bs]
+        m_prev = m_scr[..., 0]                            # [H, K]
+        l_prev = l_scr[..., 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=2))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        acc_scr[...] = (acc_scr[...] * corr[..., None]
+                        + lax.dot_general(p, v,
+                                          (((2,), (1,)), ((0,), (0,)))))
+        l_scr[...] = jnp.broadcast_to(
+            (l_prev * corr + p.sum(axis=2))[..., None], l_scr.shape)
+        m_scr[...] = jnp.broadcast_to(m_new[..., None], m_scr.shape)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        l = l_scr[..., 0]
+        o_ref[0] = (acc_scr[...]
+                    / jnp.maximum(l, 1e-30)[..., None]).astype(o_ref.dtype)
+
+
+def paged_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
+                    tables: jax.Array, qpos: jax.Array, *,
+                    block_size: int, kscale: jax.Array | None = None,
+                    vscale: jax.Array | None = None) -> jax.Array:
+    """Fused paged attention over one layer's physical block pool.
+
+    ``q``: [S, H, K, dh] queries (K = 1 for the flash-decode tick, the
+    speculative width for verify); ``kc``/``vc``: [n_blocks+1, H, bs, dh]
+    physical blocks (trash block 0 included); ``tables``: [S, NB] int32
+    logical->physical ids; ``qpos``: [S, K] int32 query positions,
+    NON-DECREASING along K (the engine's ``pos + j`` plan). With a
+    quantized pool pass ``kscale``/``vscale`` [n_blocks+1, H, bs] — the
+    per-row f32 dequant scales — and int8/fp8 ``kc``/``vc``.
+
+    Returns f32 [S, H, K, dh]: exactly what the dense-math path's masked
+    softmax-attention einsum pair produces over the gathered span, with
+    rows past each query's position masked out (trash-table entries
+    included, same as the dense mask).
+    """
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("paged_attention needs jax.experimental.pallas."
+                           "tpu (interpret mode covers non-TPU backends)")
+    S, H, K, dh = q.shape
+    NB = tables.shape[1]
+    bs = int(block_size)
+    if kc.shape[-2] != bs:
+        raise ValueError(f"kc block axis {kc.shape[-2]} != block_size {bs}")
+    quant = kscale is not None
+    if quant != (vscale is not None):
+        raise ValueError("pass both kscale and vscale, or neither")
+    scale = 1.0 / math.sqrt(dh)
+    interpret = _interpret()
+    if not interpret and dh % _LANES:  # pragma: no cover - TPU-only path
+        # Mosaic wants a 128-lane head dim; pad (a copy — deploy dh in
+        # lane multiples to avoid it; interpret mode needs no padding)
+        pad = [(0, 0)] * 3 + [(0, (-dh) % _LANES)]
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, 0), pad[3]])
+        kc = jnp.pad(kc, pad)
+        vc = jnp.pad(vc, pad)
+    dp = q.shape[-1]
+
+    def _kv_idx(s, kb, tables_ref, qpos_ref):
+        # past-the-end fetch elision: clamp at the newest query's block so
+        # skipped iterations revisit it (no HBM copy when unchanged)
+        last = qpos_ref[s, K - 1] // bs
+        return (tables_ref[s, jnp.minimum(kb, last)], 0, 0, 0)
+
+    def _q_idx(s, kb, tables_ref, qpos_ref):
+        return (s, 0, 0, 0)
+
+    def _scale_idx(s, kb, tables_ref, qpos_ref):
+        last = qpos_ref[s, K - 1] // bs
+        return (tables_ref[s, jnp.minimum(kb, last)], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, H, K, dp), _q_idx),
+        pl.BlockSpec((1, H, bs, dp), _kv_idx),
+        pl.BlockSpec((1, H, bs, dp), _kv_idx),
+    ]
+    operands = [q, kc, vc]
+    if quant:
+        in_specs += [pl.BlockSpec((1, H, bs), _scale_idx),
+                     pl.BlockSpec((1, H, bs), _scale_idx)]
+        operands += [kscale, vscale]
+
+    vma = _vma_of(q, kc, vc)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, NB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, K, dp), _q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((H, K, dp), jnp.float32),
+            pltpu.VMEM((H, K, _LANES), jnp.float32),
+            pltpu.VMEM((H, K, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, bs=bs, n_q=K, scale=scale,
+                          quant=quant),
+        grid_spec=grid_spec,
+        out_shape=_struct((S, H, K, dp), jnp.float32, vma),
+        # slots are independent; the k-block axis carries scratch state
+        compiler_params=_compiler_params("parallel", "arbitrary"),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), qpos.astype(jnp.int32), *operands)
+    return out[..., :dh]
+
+
+def paged_flash_decode(q: jax.Array, kc: jax.Array, vc: jax.Array,
+                       tables: jax.Array, pos: jax.Array, *,
+                       block_size: int, kscale: jax.Array | None = None,
+                       vscale: jax.Array | None = None) -> jax.Array:
+    """The one-query-per-slot flash-decode tick: ``q`` [S, H, 1, dh],
+    ``pos`` [S] — the ``K = 1`` specialization of :func:`paged_attention`
+    (the decode tick attends every position ``<= pos[s]``)."""
+    return paged_attention(q, kc, vc, tables, pos[:, None],
+                           block_size=block_size, kscale=kscale,
+                           vscale=vscale)

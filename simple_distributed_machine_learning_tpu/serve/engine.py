@@ -101,7 +101,17 @@ class InferenceEngine:
     block; ``n_blocks`` pool capacity (default: the dense pool's capacity,
     ``n_slots * ceil(max_len/block_size)`` — shrink it to serve more slots
     than the memory could densely back); ``prefill_chunk`` prompt positions
-    per prefill chunk (``None`` = the whole remaining prompt in one chunk).
+    per prefill chunk (``None`` = the whole remaining prompt in one chunk);
+    ``attn_kernel`` the decode/verify attention path — ``"dense"``
+    (gather-then-dense, the parity anchor) or ``"fused"`` (the Pallas
+    paged-attention kernel: block gather + online-softmax attention in one
+    HBM pass, ``ops/paged_attention.py``; greedy token streams stay
+    bit-exact vs ``"dense"``). A QUANTIZED ``cache_dtype`` (``"int8"``, or
+    fp8 where the jnp build has it) stores paged blocks narrow with
+    per-row f32 scales (``models/gpt.py::QuantKV``) — roughly 3.6x more
+    resident requests per byte than f32 at pinned-tolerance logits, with
+    dequantize fused into both attention paths; paged-only (dense layouts
+    reject it).
 
     Tensor parallelism: build ``cfg`` with ``n_tensor_parallel = tp > 1``
     (the stages stay the UNSHARDED dense build) and pass a ``mesh`` whose
@@ -126,6 +136,7 @@ class InferenceEngine:
                  max_len: int | None = None, cache_dtype=None,
                  kv_layout: str = "paged", block_size: int = 16,
                  n_blocks: int | None = None, prefill_chunk: int | None = None,
+                 attn_kernel: str = "dense",
                  metrics: ServeMetrics | None = None,
                  scheduler: FCFSScheduler | None = None,
                  clock=time.monotonic, lint: bool = False,
@@ -146,6 +157,16 @@ class InferenceEngine:
         if kv_layout not in ("paged", "dense"):
             raise ValueError(
                 f"kv_layout must be 'paged' or 'dense', got {kv_layout!r}")
+        if attn_kernel not in ("dense", "fused"):
+            raise ValueError(
+                f"attn_kernel must be 'dense' (gather-then-dense "
+                f"attention) or 'fused' (the Pallas paged-attention "
+                f"kernel), got {attn_kernel!r}")
+        if attn_kernel == "fused" and kv_layout != "paged":
+            raise ValueError(
+                "attn_kernel='fused' is the paged pool's kernel (block-"
+                "table gather fused with attention); the dense layout has "
+                "no block tables — use kv_layout='paged'")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1 (or None for whole-prompt "
@@ -170,6 +191,7 @@ class InferenceEngine:
         self.cfg = cfg
         self.stages = stages       # kept for the analyzer's program registry
         self.kv_layout = kv_layout
+        self.attn_kernel = attn_kernel
         self.prefill_chunk = prefill_chunk
         self.params = (params if params is not None
                        else [s.params for s in stages])
@@ -192,12 +214,12 @@ class InferenceEngine:
                 mesh=mesh)
             self._decode = make_paged_decode_step(
                 stages, cfg, self.max_len, block_size, cache_dtype,
-                mesh=mesh)
+                mesh=mesh, kernel=attn_kernel)
             self._copy_block = make_paged_block_copy()
             if self.speculative:
                 self._verify = make_paged_verify_step(
                     stages, cfg, self.max_len, block_size, spec_k,
-                    cache_dtype, mesh=mesh)
+                    cache_dtype, mesh=mesh, kernel=attn_kernel)
         else:
             self.pool = KVCachePool(n_layers, n_slots, cfg.n_heads,
                                     self.max_len, head_dim, cache_dtype,
@@ -215,17 +237,29 @@ class InferenceEngine:
                 raise ValueError(
                     f"draft vocab {draft_cfg.vocab} != target vocab "
                     f"{cfg.vocab} — the draft proposes target token ids")
+            # the draft pool is dense slot rows (no per-block scales), so a
+            # quantized TARGET dtype falls back to f32 for the draft — the
+            # draft cache is small by design, and its rows feed proposals
+            # only (acceptance always re-scores on the target)
+            from simple_distributed_machine_learning_tpu.models.gpt import (
+                _is_quantized_dtype,
+            )
+            self._draft_cache_dtype = (None if _is_quantized_dtype(
+                cache_dtype) else cache_dtype)
             self._draft_prefill = make_slot_prefill(
-                draft_stages, draft_cfg, self.max_len, cache_dtype)
+                draft_stages, draft_cfg, self.max_len,
+                self._draft_cache_dtype)
             self._propose = make_slot_propose(
-                draft_stages, draft_cfg, self.max_len, spec_k, cache_dtype)
+                draft_stages, draft_cfg, self.max_len, spec_k,
+                self._draft_cache_dtype)
             if self.tp == 1:
                 # single-device targets run the FUSED tick: one dispatch
                 # per speculative tick, draft rows never leave the device
                 self._spec_fused = (
                     make_paged_spec_tick(stages, cfg, draft_stages,
                                          draft_cfg, self.max_len,
-                                         block_size, spec_k, cache_dtype)
+                                         block_size, spec_k, cache_dtype,
+                                         kernel=attn_kernel)
                     if kv_layout == "paged" else
                     make_slot_spec_tick(stages, cfg, draft_stages,
                                         draft_cfg, self.max_len, spec_k,
@@ -235,7 +269,7 @@ class InferenceEngine:
                 # draft stays replicated single-device — two dispatches
                 self._spec_fused = None
             self._draft_params = [s.params for s in draft_stages]
-            self._init_draft_pool(n_slots, cache_dtype)
+            self._init_draft_pool(n_slots)
         if self.tp > 1:
             self._place_tp(mesh)
         if scheduler is None:
@@ -287,7 +321,7 @@ class InferenceEngine:
         # per-request last-emit timestamps for TPOT accounting
         self._last_emit: dict[int, float] = {}
 
-    def _init_draft_pool(self, n_slots: int, cache_dtype) -> None:
+    def _init_draft_pool(self, n_slots: int) -> None:
         """The draft model's K/V buffers: ALWAYS the dense slot layout
         (one ``max_len`` row per slot), whatever the target layout — the
         draft is small by design, so paging it buys nothing, and the dense
@@ -300,7 +334,7 @@ class InferenceEngine:
         dcfg = self.draft_cfg
         dL = sum(len(p["blocks"]) for p in self._draft_params)
         ddh = dcfg.d_model // dcfg.n_heads
-        cd = _cache_dtype(cache_dtype)
+        cd = _cache_dtype(self._draft_cache_dtype)
         shape = (dL, n_slots, dcfg.n_heads, self.max_len, ddh)
         self._dkc = jnp.zeros(shape, cd)
         self._dvc = jnp.zeros(shape, cd)
@@ -322,9 +356,14 @@ class InferenceEngine:
         from simple_distributed_machine_learning_tpu.parallel.mesh import (
             MODEL_AXIS,
         )
+        # the head axis is dim 2 in every pool leaf — block data AND (for
+        # quantized pools) the QuantKV scale planes — so one spec places
+        # the whole pytree per-shard
         cache_sh = NamedSharding(mesh, P(None, None, MODEL_AXIS))
-        self.pool.kc = jax.device_put(self.pool.kc, cache_sh)
-        self.pool.vc = jax.device_put(self.pool.vc, cache_sh)
+        self.pool.kc = jax.tree.map(
+            lambda leaf: jax.device_put(leaf, cache_sh), self.pool.kc)
+        self.pool.vc = jax.tree.map(
+            lambda leaf: jax.device_put(leaf, cache_sh), self.pool.vc)
         stacked, rep = pack_tp_serve_params(self.params, self.tp)
         blk_sh = NamedSharding(mesh, P(MODEL_AXIS))
         rep_sh = NamedSharding(mesh, P())
@@ -438,7 +477,8 @@ class InferenceEngine:
                 block_stats=(self.pool.stats()
                              if self.kv_layout == "paged" else None),
                 tp=self.tp, spec_k=self.spec_k,
-                kv_predicted=predicted, kv_drift=live - predicted)
+                kv_predicted=predicted, kv_drift=live - predicted,
+                attn_kernel=self.attn_kernel)
         if self.flight is not None:
             self.flight.snap(self, self._tick_count, emitted)
         return emitted

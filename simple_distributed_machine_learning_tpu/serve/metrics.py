@@ -33,6 +33,11 @@ Sharded + speculative instruments (ISSUE 9):
 
 - ``serve_tp`` / ``serve_spec_k`` (gauges) — the deployment shape: tensor-
   parallel width and speculative verify width (0 = plain decode);
+- ``serve_attn_kernel_fused`` (gauge, 0/1) — which attention path the
+  paged decode/verify ticks compile: 0 = gather-then-dense (the parity
+  anchor), 1 = the fused Pallas paged-attention kernel (one HBM pass of
+  resident K/V per tick; ``ops/paged_attention.py``) — dashboards
+  correlate per-tick latency shifts with the kernel path in play;
 - ``serve_spec_proposed_tokens_total`` / ``..accepted..`` / ``..rejected..``
   (counters) and ``serve_spec_accept_rate`` (histogram, one observation
   per speculative tick) — how much of the draft's work the target agreed
@@ -166,6 +171,7 @@ class ServeMetrics:
         # shape gauges every tick and the spec counters per verify
         self.tp_gauge = r.gauge("serve_tp")
         self.spec_k_gauge = r.gauge("serve_spec_k")
+        self.attn_kernel_gauge = r.gauge("serve_attn_kernel_fused")
         self.spec_proposed = r.counter("serve_spec_proposed_tokens_total")
         self.spec_accepted = r.counter("serve_spec_accepted_tokens_total")
         self.spec_rejected = r.counter("serve_spec_rejected_tokens_total")
@@ -327,7 +333,8 @@ class ServeMetrics:
                 block_stats: dict | None = None,
                 tp: int | None = None, spec_k: int | None = None,
                 kv_predicted: int | None = None,
-                kv_drift: int | None = None) -> None:
+                kv_drift: int | None = None,
+                attn_kernel: str | None = None) -> None:
         """End-of-tick gauges; ``decode_active`` is the occupancy the tick's
         batched decode ran at (sampled BEFORE same-tick retirement — the
         number batching converts into throughput). Ticks that ran no decode
@@ -346,6 +353,8 @@ class ServeMetrics:
             self._shape_seen = True
             self.tp_gauge.set(tp)
             self.spec_k_gauge.set(spec_k or 0)
+        if attn_kernel is not None:
+            self.attn_kernel_gauge.set(int(attn_kernel == "fused"))
         occ = active if decode_active is None else decode_active
         if occ and total:
             self.occupancy.observe(occ / total)

@@ -58,14 +58,37 @@ def kv_block_bytes(n_layers: int, n_heads: int, block_size: int,
     ``bytes_per_block`` (and therefore the ``serve_kv_bytes_resident``
     gauge) from it, and the analyzer's HBM-bytes-per-tick model
     (``analysis/programs.py``) predicts against it — the cross-check in
-    tests/test_analysis_serve.py holds because both sides share this."""
+    tests/test_analysis_serve.py holds because both sides share this.
+
+    QUANTIZED dtypes (int8/fp8, ``models/gpt.py::_is_quantized_dtype``)
+    add the per-block scale planes to the bill: one f32 scale per
+    (position, head) row, for K and for V — the honest block footprint,
+    so a fixed-byte pool sizing (``n_blocks_for_bytes``) and the
+    resident-bytes gauge can never claim the scale planes are free."""
     import jax.numpy as jnp
 
     from simple_distributed_machine_learning_tpu.models.gpt import (
         _cache_dtype,
+        _is_quantized_dtype,
     )
-    return int(2 * n_layers * n_heads * block_size * head_dim
-               * jnp.dtype(_cache_dtype(cache_dtype)).itemsize)
+    cd = _cache_dtype(cache_dtype)
+    bytes_ = (2 * n_layers * n_heads * block_size * head_dim
+              * jnp.dtype(cd).itemsize)
+    if _is_quantized_dtype(cache_dtype):
+        bytes_ += 2 * n_layers * n_heads * block_size * 4   # f32 scales
+    return int(bytes_)
+
+
+def n_blocks_for_bytes(budget_bytes: int, n_layers: int, n_heads: int,
+                       block_size: int, head_dim: int,
+                       cache_dtype=None) -> int:
+    """Physical blocks a ``budget_bytes`` K/V budget funds — the
+    fixed-KV-bytes sizing rule the ``bench.py --serve`` quantized
+    concurrency sweep uses (an int8 pool fits ~4x the f32 blocks of the
+    same budget, scale planes already billed)."""
+    per = kv_block_bytes(n_layers, n_heads, block_size, head_dim,
+                         cache_dtype)
+    return max(1, budget_bytes // per)
 
 
 def _bind_seq_of(request) -> np.ndarray:
@@ -212,10 +235,13 @@ class KVCachePool(_SlotPoolBase):
 
         from simple_distributed_machine_learning_tpu.models.gpt import (
             _cache_dtype,
+            _check_cache_quantization,
         )
+        _check_cache_quantization(cache_dtype, "KVCachePool", paged=False)
         self.tp = _check_tp(n_heads, tp)
         shape = (n_layers, n_slots, n_heads, max_len, head_dim)
         cd = _cache_dtype(cache_dtype)
+        self.cache_dtype = cd
         self.kc = jnp.zeros(shape, cd)
         self.vc = jnp.zeros(shape, cd)
         # PER-SHARD bytes, like the paged pool's bytes_per_block: one row
@@ -250,8 +276,12 @@ class PagedKVPool(_SlotPoolBase):
     Copy-on-write: writers must call :meth:`ensure_writable` before landing
     K/V at a position. A block referenced by more than one request is copied
     first (the caller performs the device copy of the ``(src, dst)`` pair
-    this returns); a block referenced once is written in place, dropping any
-    registered prefix whose covered rows the write would clobber.
+    this returns) — UNLESS the writing slot is the block's original
+    allocator: sharers trust only the rows below their registered fill and
+    copy before their own first write, so the allocator's tail rows land in
+    place even while shared (no copy, and no unbudgeted reservation draw).
+    A block referenced once is written in place, dropping any registered
+    prefix whose covered rows the write would clobber.
 
     Reservation accounting makes on-demand allocation safe: admission
     reserves this sequence's worst-case block budget (its total rows minus
@@ -285,13 +315,29 @@ class PagedKVPool(_SlotPoolBase):
         import jax.numpy as jnp
 
         from simple_distributed_machine_learning_tpu.models.gpt import (
+            QuantKV,
             _cache_dtype,
+            _check_cache_quantization,
+            _is_quantized_dtype,
         )
+        _check_cache_quantization(cache_dtype, "PagedKVPool", paged=True)
         cd = _cache_dtype(cache_dtype)
+        self.cache_dtype = cd
+        self.quantized = _is_quantized_dtype(cache_dtype)
         # +1: physical block 0 is the trash block, never allocated
         shape = (n_layers, n_blocks + 1, n_heads, block_size, head_dim)
-        self.kc = jnp.zeros(shape, cd)
-        self.vc = jnp.zeros(shape, cd)
+        if self.quantized:
+            # narrow block data + per-(position, head) f32 scale planes as
+            # ONE pytree buffer per cache (models/gpt.py::QuantKV): every
+            # compiled step, the CoW copy, donation and TP placement
+            # thread the pair together
+            self.kc = QuantKV(jnp.zeros(shape, cd),
+                              jnp.zeros(shape[:-1], jnp.float32))
+            self.vc = QuantKV(jnp.zeros(shape, cd),
+                              jnp.zeros(shape[:-1], jnp.float32))
+        else:
+            self.kc = jnp.zeros(shape, cd)
+            self.vc = jnp.zeros(shape, cd)
         # PER-SHARD bytes (heads split tp ways by the TP serving programs):
         # the gauge tracks what one chip actually pins, which is the number
         # TP sharding exists to shrink — and what the analyzer's
@@ -306,6 +352,15 @@ class PagedKVPool(_SlotPoolBase):
         # bumped on every _prefix mutation (register/drop/evict): versions
         # the per-request probe memo in _probe_cached
         self._registry_epoch = 0
+        # block -> the slot that ALLOCATED it and may still write it in
+        # place while sharers hold references (see ensure_writable):
+        # sharers only ever trust rows below their registered fill, and
+        # they copy-on-write before their own first write, so the
+        # writer's tail rows can land in place without a copy — and
+        # without consuming a reservation its admission budget never
+        # included (the overrun guard tests/test_paged_attention.py's
+        # mid-decode sharing scenario exposed)
+        self._block_writer: dict[int, int] = {}
         self._lru: collections.OrderedDict[int, None] = (
             collections.OrderedDict())                 # reclaimable, LRU order
         self._reserved = 0
@@ -442,6 +497,10 @@ class PagedKVPool(_SlotPoolBase):
         blocks become reclaimable, uncached ones free) and return the unused
         reservation. The slot itself is released separately (scheduler)."""
         for block in self.tables[slot]:
+            # surviving sharers lose the in-place-writer privilege with
+            # the allocator gone (they fall back to plain CoW-at-ref>1)
+            if self._block_writer.get(block) == slot:
+                del self._block_writer[block]
             self._unref_block(block)
         self.tables[slot] = []
         self._reserved -= int(self._resv[slot])
@@ -473,13 +532,18 @@ class PagedKVPool(_SlotPoolBase):
             table.append(self._alloc_block(slot))
             return None
         phys = table[j]
-        if self.ref[phys] > 1:
+        if self.ref[phys] > 1 and self._block_writer.get(phys) != slot:
+            # a SHARED-IN block: this slot referenced it through the
+            # prefix registry, so its own rows must land in a private copy
             dst = self._alloc_block(slot)
             table[j] = dst
             self._unref_block(phys)
             self.cow_copies_total += 1
             return (phys, dst)
-        # singly-referenced: in-place, but invalidate stale prefix promises
+        # singly-referenced, or shared but THIS slot allocated it (sharers
+        # trust only rows below their registered fill and copy before
+        # writing, so the allocator's tail writes are invisible to them):
+        # in-place, but invalidate stale prefix promises
         off = position % self.block_size
         for key in list(self._cached.get(phys, ())):
             if self._prefix[key][1] > off:
@@ -511,6 +575,7 @@ class PagedKVPool(_SlotPoolBase):
         if block == self.TRASH:     # pragma: no cover - guard
             raise RuntimeError("the trash block leaked into the free list")
         self.ref[block] = 1
+        self._block_writer[block] = slot
         self._resv[slot] -= 1
         self._reserved -= 1
         return block
@@ -527,6 +592,7 @@ class PagedKVPool(_SlotPoolBase):
                                f"double free")
         self.ref[block] -= 1
         if self.ref[block] == 0:
+            self._block_writer.pop(block, None)
             if self._cached.get(block):
                 self._lru[block] = None        # reclaimable, newest last
             else:

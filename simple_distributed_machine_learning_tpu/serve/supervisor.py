@@ -183,7 +183,15 @@ def engine_factory(stages, cfg, *, metrics=None, clock=time.monotonic,
             dcfg = dataclasses.replace(cfg, n_tensor_parallel=1)
         dkw = {k: v for k, v in kw.items()
                if k not in ("block_size", "n_blocks", "prefill_chunk",
-                            "kv_layout")}
+                            "kv_layout", "attn_kernel")}
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            _is_quantized_dtype,
+        )
+        if _is_quantized_dtype(dkw.get("cache_dtype")):
+            # quantized blocks (and the fused kernel dropped above) are
+            # paged-pool features; the dense fallback widens to f32 —
+            # same rule degraded_spec mirrors for the lint gate
+            dkw["cache_dtype"] = None
         return InferenceEngine(stages, dcfg, kv_layout="dense",
                                metrics=metrics, clock=clock,
                                scheduler=scheduler, **dkw)

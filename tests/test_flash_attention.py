@@ -155,8 +155,11 @@ def test_flash_bf16_inputs():
     assert out.dtype == jnp.bfloat16
     ref = _dense_reference(q.astype(jnp.float32), k.astype(jnp.float32),
                            v.astype(jnp.float32))
+    from tolerances import attn_tol
+
+    rtol, atol = attn_tol(jnp.bfloat16)
     np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
-                               rtol=5e-2, atol=5e-2)
+                               rtol=rtol, atol=atol)
 
 
 def test_gpt_flash_gradients_match_dense():

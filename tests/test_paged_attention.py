@@ -1,0 +1,430 @@
+"""Fused Pallas paged-attention kernels + the int8-quantized KV pool.
+
+Runs the real kernel code path in Pallas interpret mode on CPU (the same
+kernel lowers through Mosaic on TPU), pinned against the gather-then-dense
+attention math every serving program used before ISSUE 15:
+
+- kernel-level parity: the flash-decode (K=1) and K-token verify variants
+  vs the dense masked-softmax reference over the gathered span, including
+  the fused-dequant int8 path against the SAME dequantized rows (tight
+  tolerance: identical effective K/V, only accumulation order differs);
+- engine-level bit-exactness: greedy token streams through
+  ``attn_kernel="fused"`` equal the ``"dense"`` path's EXACTLY (f32, bf16,
+  int8; plain and speculative ticks) — the ISSUE-15 acceptance anchor;
+- quantized pool coverage: quantize→dequantize round-trip error bounds,
+  ``kv_block_bytes`` scale-plane accounting, copy-on-write + prefix
+  sharing refcounts over quantized blocks, TP=2 vs TP=1 token parity,
+  and the fixed-KV-bytes >= 2x resident-request win vs bf16;
+- the analyzer's HBM-bytes-per-tick model: the dense path carries the
+  ``kv_attn_reread`` pass, the fused path is single-pass, quantized rows
+  bill their scale bytes.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from tolerances import attn_tol
+
+from simple_distributed_machine_learning_tpu.models.gpt import (
+    GPTConfig,
+    QuantKV,
+    _quantize_rows,
+    make_gpt_stages,
+    make_paged_block_copy,
+)
+from simple_distributed_machine_learning_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_flash_decode,
+)
+from simple_distributed_machine_learning_tpu.serve import InferenceEngine
+from simple_distributed_machine_learning_tpu.serve.slots import (
+    PagedKVPool,
+    kv_block_bytes,
+    n_blocks_for_bytes,
+)
+
+CFG = GPTConfig(vocab=64, seq_len=32, d_model=32, n_heads=2, n_layers=2)
+
+
+@pytest.fixture(scope="module")
+def stages():
+    return make_gpt_stages(jax.random.key(0), CFG, 1)[0]
+
+
+def _dense_paged_reference(q, kc, vc, tables, qpos):
+    """Gather-then-dense masked attention over the table span — exactly
+    the serving programs' pre-kernel math (``models/gpt.py``)."""
+    S, H, K, dh = q.shape
+    NB = tables.shape[1]
+    bs = kc.shape[-2]
+    span = NB * bs
+    outs = []
+    for s in range(S):
+        krow = np.moveaxis(np.asarray(kc, np.float32)[tables[s]], 0,
+                           1).reshape(H, span, dh)
+        vrow = np.moveaxis(np.asarray(vc, np.float32)[tables[s]], 0,
+                           1).reshape(H, span, dh)
+        sc = jnp.einsum("hqd,hkd->hqk", q[s].astype(jnp.float32),
+                        krow) / math.sqrt(dh)
+        live = np.arange(span)[None, None, :] <= qpos[s][None, :, None]
+        sc = jnp.where(live, sc, -jnp.inf)
+        outs.append(jnp.einsum("hqk,hkd->hqd", jax.nn.softmax(sc, -1),
+                               vrow))
+    return jnp.stack(outs)
+
+
+def _toy_pool(key, S=3, H=2, dh=16, bs=4, NB=6, NBtot=12):
+    kq, kk, kv = jax.random.split(key, 3)
+    kc = jax.random.normal(kk, (NBtot, H, bs, dh))
+    vc = jax.random.normal(kv, (NBtot, H, bs, dh))
+    tables = np.zeros((S, NB), np.int32)
+    tables[0, :4] = [2, 5, 7, 8]
+    tables[1, :2] = [1, 3]
+    tables[2, :1] = [9]
+    pos = np.array([10, 4, 0], np.int32)
+    return kq, kc, vc, tables, pos
+
+
+def test_paged_flash_decode_matches_dense_gather():
+    kq, kc, vc, tables, pos = _toy_pool(jax.random.key(0))
+    q = jax.random.normal(kq, (3, 2, 1, 16))
+    out = jax.jit(lambda *a: paged_flash_decode(*a, block_size=4))(
+        q, kc, vc, jnp.asarray(tables), jnp.asarray(pos))
+    ref = _dense_paged_reference(q, kc, vc, tables, pos[:, None])
+    rtol, atol = attn_tol(jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=rtol, atol=atol)
+
+
+def test_paged_attention_verify_variant_matches_dense_gather():
+    """The K-token variant: per-query masks at qpos = pos + j."""
+    kq, kc, vc, tables, pos = _toy_pool(jax.random.key(1))
+    K = 4
+    q = jax.random.normal(kq, (3, 2, K, 16))
+    qpos = np.minimum(pos[:, None] + np.arange(K)[None, :],
+                      6 * 4 - 1).astype(np.int32)
+    out = jax.jit(lambda *a: paged_attention(*a, block_size=4))(
+        q, kc, vc, jnp.asarray(tables), jnp.asarray(qpos))
+    ref = _dense_paged_reference(q, kc, vc, tables, qpos)
+    rtol, atol = attn_tol(jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=rtol, atol=atol)
+
+
+def test_paged_attention_fused_dequant_matches_dequantized_rows():
+    """int8 blocks + per-row scales through the kernel == dense attention
+    over the EXPLICITLY dequantized rows — same effective K/V, so the
+    comparison is tight (accumulation order only), proving dequantize is
+    fused faithfully rather than approximated."""
+    kq, kc, vc, tables, pos = _toy_pool(jax.random.key(2))
+    K = 2
+    q = jax.random.normal(kq, (3, 2, K, 16))
+    qpos = np.minimum(pos[:, None] + np.arange(K)[None, :],
+                      23).astype(np.int32)
+    kd, ks = _quantize_rows(kc, jnp.int8)
+    vd, vs = _quantize_rows(vc, jnp.int8)
+    out = jax.jit(lambda *a: paged_attention(
+        *a[:5], block_size=4, kscale=a[5], vscale=a[6]))(
+        q, kd, vd, jnp.asarray(tables), jnp.asarray(qpos), ks, vs)
+    deq_k = kd.astype(jnp.float32) * ks[..., None]
+    deq_v = vd.astype(jnp.float32) * vs[..., None]
+    ref = _dense_paged_reference(q, deq_k, deq_v, tables, qpos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # and the quantized result tracks the UNQUANTIZED one inside the
+    # pinned int8 tolerance (the round-trip error budget)
+    full = _dense_paged_reference(q, kc, vc, tables, qpos)
+    rtol, atol = attn_tol(jnp.int8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                               rtol=rtol, atol=atol)
+
+
+def test_quantize_roundtrip_error_bound():
+    """|x - dequant(quant(x))| <= amax_row / (2 * qmax) elementwise — the
+    per-row scale scheme's analytic bound (int8 qmax = 127)."""
+    x = jax.random.normal(jax.random.key(3), (5, 4, 8, 32)) * 3.0
+    qd, sc = _quantize_rows(x, jnp.int8)
+    assert qd.dtype == jnp.int8 and sc.dtype == jnp.float32
+    deq = qd.astype(jnp.float32) * sc[..., None]
+    amax = np.max(np.abs(np.asarray(x)), axis=-1, keepdims=True)
+    bound = amax / (2 * 127.0) + 1e-6
+    assert np.all(np.abs(np.asarray(deq - x)) <= bound)
+    # all-zero rows stay finite and decode to zero
+    z = jnp.zeros((2, 4))
+    zd, zs = _quantize_rows(z, jnp.int8)
+    assert np.all(np.asarray(zd) == 0) and np.all(np.isfinite(zs))
+
+
+def test_kv_block_bytes_accounts_scale_planes():
+    L, H, bs, dh = 2, 2, 4, 16
+    f32 = kv_block_bytes(L, H, bs, dh)
+    bf16 = kv_block_bytes(L, H, bs, dh, "bfloat16")
+    i8 = kv_block_bytes(L, H, bs, dh, "int8")
+    assert f32 == 2 * L * H * bs * dh * 4
+    assert bf16 == f32 // 2
+    # int8 data + one f32 scale per (position, head) row, K and V
+    assert i8 == 2 * L * H * bs * dh * 1 + 2 * L * H * bs * 4
+    assert i8 < bf16 < f32
+    # the pool's bytes_per_block uses the same formula (scales included)
+    pool = PagedKVPool(L, 2, H, 16, dh, cache_dtype="int8", block_size=bs)
+    assert pool.bytes_per_block == i8
+    assert isinstance(pool.kc, QuantKV)
+    assert pool.kc.nbytes == (pool.kc.data.nbytes + pool.kc.scale.nbytes)
+    # fixed-byte sizing: the int8 budget funds strictly more blocks
+    budget = 10 * bf16
+    assert (n_blocks_for_bytes(budget, L, H, bs, dh, "int8")
+            > n_blocks_for_bytes(budget, L, H, bs, dh, "bfloat16"))
+
+
+def test_quantized_cache_is_paged_only():
+    from simple_distributed_machine_learning_tpu.serve.slots import (
+        KVCachePool,
+    )
+
+    with pytest.raises(ValueError, match="paged"):
+        KVCachePool(2, 2, 2, 16, 16, cache_dtype="int8")
+
+
+def test_engine_knob_validation(stages):
+    with pytest.raises(ValueError, match="attn_kernel"):
+        InferenceEngine(stages, CFG, attn_kernel="magic")
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(stages, CFG, kv_layout="dense",
+                        attn_kernel="fused")
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(stages, CFG, kv_layout="dense",
+                        cache_dtype="int8")
+
+
+def _drain_tokens(stages, cfg, prompts, max_new=8, **kw):
+    engine = InferenceEngine(stages, cfg, n_slots=3, block_size=4, **kw)
+    handles = [engine.submit(p, max_new_tokens=max_new, seed=100 + i)
+               for i, p in enumerate(prompts)]
+    engine.drain()
+    return engine, [list(h.tokens) for h in handles]
+
+
+def _prompts(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab, t).astype(np.int32)
+            for t in (5, 9, 13, 7)[:n]]
+
+
+@pytest.mark.parametrize("cache_dtype", [None, "bfloat16", "int8"])
+def test_engine_greedy_fused_bit_exact_vs_dense_path(stages, cache_dtype):
+    """THE acceptance anchor: greedy decode through attn_kernel='fused'
+    emits the exact token stream of the gather-then-dense path — per
+    storage dtype (f32/bf16 bit-exact vs their own dense path; the int8
+    pool vs ITS dense path, quantization identical on both sides)."""
+    prompts = _prompts()
+    _, dense = _drain_tokens(stages, CFG, prompts, cache_dtype=cache_dtype)
+    _, fused = _drain_tokens(stages, CFG, prompts, cache_dtype=cache_dtype,
+                             attn_kernel="fused")
+    assert dense == fused
+
+
+def test_engine_speculative_fused_bit_exact(stages):
+    """The K-token verify variant through the engine: fused speculative
+    greedy streams equal the dense-path speculative ones AND the plain
+    decode's (the existing spec-decode bit-exactness contract composes
+    with the kernel)."""
+    prompts = _prompts()
+    kw = dict(draft_stages=stages, draft_cfg=CFG, spec_k=3)
+    _, plain = _drain_tokens(stages, CFG, prompts)
+    _, sp_dense = _drain_tokens(stages, CFG, prompts, **kw)
+    _, sp_fused = _drain_tokens(stages, CFG, prompts,
+                                attn_kernel="fused", **kw)
+    assert sp_dense == sp_fused == plain
+    # and over the quantized pool (fused vs dense, both int8)
+    _, q_dense = _drain_tokens(stages, CFG, prompts, cache_dtype="int8",
+                               **kw)
+    _, q_fused = _drain_tokens(stages, CFG, prompts, cache_dtype="int8",
+                               attn_kernel="fused", **kw)
+    assert q_dense == q_fused
+
+
+def test_quantized_pool_prefix_sharing_cow_refcounts(stages):
+    """Copy-on-write + prefix sharing over int8 blocks: shared prompts
+    reference the same physical blocks (prefix hits), divergence copies
+    data AND scale planes (CoW counter), refcounts release cleanly, and
+    sharing cannot change anyone's tokens vs an unshared run."""
+    rng = np.random.default_rng(7)
+    common = rng.integers(0, CFG.vocab, 9).astype(np.int32)
+    prompts = [common,
+               np.concatenate([common, [3, 5]]).astype(np.int32),
+               np.concatenate([common, [11]]).astype(np.int32)]
+
+    def serial_tokens(**kw):
+        """One at a time through a fresh engine each — sharing impossible."""
+        toks = []
+        for i, p in enumerate(prompts):
+            engine = InferenceEngine(stages, CFG, n_slots=3, block_size=4,
+                                     **kw)
+            h = engine.submit(p, max_new_tokens=6, seed=100 + i)
+            engine.drain()
+            toks.append(list(h.tokens))
+        return toks
+
+    engine = InferenceEngine(stages, CFG, n_slots=3, block_size=4,
+                             cache_dtype="int8")
+    # r0 boards and registers its prompt blocks; r1/r2 then share them
+    # while r0 is STILL LIVE (ref >= 2), so their divergent writes into
+    # the shared partial tail block must copy-on-write
+    handles = [engine.submit(prompts[0], max_new_tokens=6, seed=100)]
+    engine.step()               # r0's prefill completes + registry publish
+    for i, p in enumerate(prompts[1:], start=1):
+        handles.append(engine.submit(p, max_new_tokens=6, seed=100 + i))
+    engine.drain()
+    stats = engine.pool.stats()
+    assert stats["prefix_hit_blocks_total"] > 0, "no prefix sharing fired"
+    assert stats["cow_copies_total"] > 0, "no copy-on-write fired"
+    # refcount discipline: nothing live after drain; cached blocks are
+    # reclaimable, the rest free; the trash block is never referenced
+    assert engine.pool.blocks_in_use == 0
+    assert int(engine.pool.ref[PagedKVPool.TRASH]) == 0
+    assert (stats["blocks_free"] + stats["blocks_cached"]
+            == engine.pool.n_blocks)
+    # sharing + CoW changed nothing about the streams
+    assert [list(h.tokens) for h in handles] == serial_tokens(
+        cache_dtype="int8")
+
+
+def test_quantized_block_copy_moves_scale_planes():
+    """The CoW device op must copy a QuantKV block's data AND its scale
+    plane — rows without their scales decode to a different value."""
+    L, H, bs, dh, NB = 2, 2, 4, 8, 3
+    data = jnp.arange(L * (NB + 1) * H * bs * dh,
+                      dtype=jnp.float32).reshape(L, NB + 1, H, bs, dh)
+    qd, sc = _quantize_rows(data, jnp.int8)
+    # the copy op DONATES its buffers: snapshot host copies first
+    qd_np, sc_np = np.asarray(qd), np.asarray(sc)
+    kc = QuantKV(qd, sc)
+    vc = QuantKV(qd + 0, sc + 0.0)
+    copy = make_paged_block_copy()
+    kc2, vc2 = copy(kc, vc, jnp.int32(1), jnp.int32(3))
+    np.testing.assert_array_equal(np.asarray(kc2.data[:, 1]), qd_np[:, 3])
+    np.testing.assert_array_equal(np.asarray(kc2.scale[:, 1]), sc_np[:, 3])
+    np.testing.assert_array_equal(np.asarray(vc2.scale[:, 2]), sc_np[:, 2])
+
+
+def test_tp2_quantized_pool_token_parity(stages):
+    """TP=2 over the head-sharded int8 pool (data + scale planes both
+    split on the head axis) emits TP=1's exact tokens — fused kernel
+    included (the kernel runs per shard inside shard_map)."""
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    prompts = _prompts(3)
+    _, base = _drain_tokens(stages, CFG, prompts, cache_dtype="int8")
+    tp_cfg = dataclasses.replace(CFG, n_tensor_parallel=2)
+    mesh = make_mesh(n_stages=1, n_data=1, n_model=2)
+    _, tp_dense = _drain_tokens(stages, tp_cfg, prompts,
+                                cache_dtype="int8", mesh=mesh)
+    assert tp_dense == base
+    _, tp_fused = _drain_tokens(stages, tp_cfg, prompts,
+                                cache_dtype="int8", mesh=mesh,
+                                attn_kernel="fused")
+    assert tp_fused == base
+
+
+def test_int8_pool_doubles_resident_requests_at_fixed_bytes(stages):
+    """The ISSUE-15 capacity gate, engine-level: at the SAME KV byte
+    budget (scale planes billed), an int8 pool sustains >= 2x the
+    simultaneously resident requests of the bf16 pool under a burst."""
+    L = sum(len(p["blocks"]) for p in (s.params for s in stages))
+    dh = CFG.d_model // CFG.n_heads
+    bs, max_new, plen = 4, 8, 13
+    ml = plen + max_new
+    bpr = -(-ml // bs)
+    budget = (2 * bpr + 1) * kv_block_bytes(L, CFG.n_heads, bs, dh,
+                                            "bfloat16")
+    rng = np.random.default_rng(5)
+    peaks = {}
+    for cd in ("bfloat16", "int8"):
+        nb = n_blocks_for_bytes(budget, L, CFG.n_heads, bs, dh, cd)
+        engine = InferenceEngine(stages, CFG, n_slots=nb // bpr + 1,
+                                 max_len=ml, block_size=bs, n_blocks=nb,
+                                 cache_dtype=cd)
+        for i in range(3 * (nb // bpr + 1)):
+            engine.submit(rng.integers(0, CFG.vocab, plen).astype(np.int32),
+                          max_new_tokens=max_new, seed=i)
+        peak = 0
+        while engine.busy:
+            engine.step()
+            peak = max(peak, engine.pool.n_active)
+        peaks[cd] = peak
+    assert peaks["int8"] >= 2 * peaks["bfloat16"], peaks
+
+
+def test_hbm_model_matches_kernel_single_pass(stages):
+    """The analyzer's per-tick model: dense path = gather + attn reread
+    (two passes), fused = the gather pass alone; quantized rows bill
+    data + scale bytes via the same kv_block_bytes rule the pool uses."""
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        ServeSpec,
+        hbm_tick_costs,
+    )
+
+    def costs(**kw):
+        s = ServeSpec(CFG, n_slots=4, kv_layout="paged", block_size=4,
+                      **kw)
+        return {h.op: h.bytes_per_tick for h in hbm_tick_costs(s)}
+
+    cd = costs()
+    cf = costs(attn_kernel="fused")
+    assert "decode.kv_attn_reread" in cd
+    assert "decode.kv_attn_reread" not in cf
+    assert cd["decode.kv_gather"] == cf["decode.kv_gather"]
+    assert (cd["decode.kv_gather"] + cd["decode.kv_attn_reread"]
+            == 2 * cf["decode.kv_gather"])
+    # quantized traffic: per-position bytes == the pool's per-row bytes
+    dh = CFG.d_model // CFG.n_heads
+    cq = costs(cache_dtype="int8")
+    per_pos = kv_block_bytes(1, CFG.n_heads, 1, dh, "int8")
+    span = -(-CFG.seq_len // 4) * 4
+    assert cq["decode.kv_gather"] == 4 * CFG.n_layers * span * per_pos
+    # the speculative verify mirrors the decode rule
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    cv = costs(spec_k=3, draft_cfg=draft_cfg)
+    cvf = costs(spec_k=3, draft_cfg=draft_cfg, attn_kernel="fused")
+    assert "verify.kv_attn_reread" in cv
+    assert "verify.kv_attn_reread" not in cvf
+
+
+def test_engine_lint_covers_fused_quantized(stages):
+    """InferenceEngine(lint=True) preflights the EXACT fused + int8
+    programs (QuantKV abstract buffers, kernel path) without ERROR
+    findings, and the drift gauge's prediction matches the pool."""
+    engine = InferenceEngine(stages, CFG, n_slots=2, block_size=4,
+                             cache_dtype="int8", attn_kernel="fused",
+                             lint=True)
+    h = engine.submit(np.arange(5, dtype=np.int32), max_new_tokens=4)
+    engine.step()
+    live, predicted = engine.kv_drift()
+    assert live == predicted > 0
+    engine.drain()
+    assert h.state == "done"
+
+
+@pytest.mark.skipif(not hasattr(jnp, "float8_e4m3fn"),
+                    reason="no fp8 in this jnp build")
+def test_fp8_cache_roundtrip_and_engine(stages):
+    """fp8 (e4m3) where available: round-trip inside the pinned fp8
+    tolerance and engine greedy parity fused-vs-dense."""
+    x = jax.random.normal(jax.random.key(9), (4, 8, 16))
+    qd, sc = _quantize_rows(x, jnp.float8_e4m3fn)
+    deq = np.asarray(qd.astype(jnp.float32) * sc[..., None])
+    rtol, atol = attn_tol(jnp.float8_e4m3fn)
+    np.testing.assert_allclose(deq, np.asarray(x), rtol=rtol, atol=atol)
+    prompts = _prompts(2)
+    _, dense = _drain_tokens(stages, CFG, prompts,
+                             cache_dtype=jnp.float8_e4m3fn)
+    _, fused = _drain_tokens(stages, CFG, prompts,
+                             cache_dtype=jnp.float8_e4m3fn,
+                             attn_kernel="fused")
+    assert dense == fused
