@@ -34,7 +34,14 @@ Rule families (the catalog table lives in docs/ARCHITECTURE.md):
   dropped the ``_DECODE_BUILD_CACHE`` memo (``programs.py``);
 - ``sharded-state`` — gather-before-use / reduce-before-update over
   declared ZeRO-style shards (``spec(..., vary=('data',))``), the
-  fully-sharded-training groundwork.
+  fully-sharded-training groundwork;
+- ``kernel-oob`` / ``kernel-unproven`` / ``kernel-race`` /
+  ``kernel-tile`` / ``kernel-dtype-drift`` / ``kernel-hbm`` — static
+  verification INSIDE every ``pallas_call`` (``kernels.py``): BlockSpec
+  index-map bounds proofs over the grid + scalar-prefetch contracts,
+  grid write-race detection on parallel axes, Mosaic tiling / scratch
+  dtype lint, and kernel-derived HBM cost rows reconciled exactly
+  against the serve registry's tick model.
 
 ``programs.py`` is the whole-program registry (every compiled entry point
 with abstract-arg builders + the HBM-bytes-per-tick cost model);
@@ -95,12 +102,16 @@ def __getattr__(name: str):
 def analyze_jaxpr(closed_jaxpr, mesh=None, name: str = "",
                   arg_ranges=None, arg_vary=None) -> Report:
     """Run the lint suite over an already-traced ``ClosedJaxpr``."""
+    from simple_distributed_machine_learning_tpu.analysis.kernels import (
+        kernel_hbm_costs,
+    )
     from simple_distributed_machine_learning_tpu.analysis.rules import (
         run_rules,
     )
     findings, costs = run_rules(closed_jaxpr, active_mesh=mesh,
                                 arg_ranges=arg_ranges, arg_vary=arg_vary)
-    return Report(name=name, findings=findings, costs=costs)
+    return Report(name=name, findings=findings, costs=costs,
+                  hbm=kernel_hbm_costs(closed_jaxpr, program=name))
 
 
 def _unwrap_specs(abstract_args, abstract_kwargs):

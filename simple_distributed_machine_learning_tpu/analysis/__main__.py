@@ -55,6 +55,13 @@ def main(argv=None) -> int:
                         "block/chunk shapes, the dense layout, the "
                         "speculative pair and the serve supervisor's "
                         "degraded-fallback layout")
+    p.add_argument("--serve-kernel", action="store_true",
+                   help="kernel-only preflight over the same registry "
+                        "sweep: every layout's Pallas kernel paths must "
+                        "lint clean — zero kernel-family findings at ANY "
+                        "severity (no unproven index maps), zero trace "
+                        "failures (the gate ROADMAP #2's autotuner runs "
+                        "every candidate through)")
     p.add_argument("--hostlint", action="store_true",
                    help="host-side AST lint: decode builders memoized "
                         "through _DECODE_BUILD_CACHE, no bypass call "
@@ -79,17 +86,19 @@ def main(argv=None) -> int:
         )
         print("rule families: ppermute-deadlock unreduced-gradient "
               "mesh-axis dtype-drift donation scatter-bounds "
-              "retrace-explosion sharded-state hostlint")
+              "retrace-explosion sharded-state hostlint "
+              "kernel-oob kernel-unproven kernel-race kernel-tile "
+              "kernel-dtype-drift kernel-hbm")
         print("fixtures:")
         for fx in FIXTURES.values():
             kind = "defect" if fx.defect else "clean"
             print(f"  {fx.name:<24} [{kind:>6}] {fx.description}")
         return 0
 
-    if not (args.hostlint or args.serve or args.fixtures
+    if not (args.hostlint or args.serve or args.serve_kernel or args.fixtures
             or args.fixture is not None or args.dryrun is not None):
-        p.error("nothing to do: pass --dryrun N, --serve, --hostlint, "
-                "--fixture NAME, --fixtures or --list")
+        p.error("nothing to do: pass --dryrun N, --serve, --serve-kernel, "
+                "--hostlint, --fixture NAME, --fixtures or --list")
     if args.dryrun is not None and args.dryrun < 1:
         p.error(f"--dryrun needs a positive device count, got "
                 f"{args.dryrun}")
@@ -99,7 +108,7 @@ def main(argv=None) -> int:
     # gate).  Bootstrap once, sized for the most demanding requested mode —
     # --hostlint alone stays jax-free (pure ast; pinned by a purge-and-block
     # subprocess test).
-    need = max(1 if args.serve else 0,
+    need = max(1 if (args.serve or args.serve_kernel) else 0,
                8 if (args.fixtures or args.fixture is not None) else 0,
                args.dryrun or 0)
     if need:
@@ -128,6 +137,33 @@ def main(argv=None) -> int:
         print(f"analysis --serve: {len(reports)} layouts "
               f"{'clean' if serve_ok else 'FLAGGED'}")
         ok &= serve_ok
+
+    if args.serve_kernel:
+        from simple_distributed_machine_learning_tpu.analysis.kernels import (
+            KERNEL_FAMILIES,
+        )
+        from simple_distributed_machine_learning_tpu.analysis.programs import (
+            default_registry_reports,
+        )
+        reports = default_registry_reports()
+        gating = [f for r in reports for f in r.findings
+                  if f.family in KERNEL_FAMILIES or f.rule == "trace.failed"]
+        for f in gating:
+            print("\n".join("  " + ln for ln in f.format().splitlines()))
+        for r in reports:
+            rows = [h for h in r.hbm if h.op.startswith("kernel.")]
+            if rows:
+                print(f"{r.name}: "
+                      + ", ".join(f"{h.program} {h.op}="
+                                  f"{h.bytes_per_tick}B" for h in rows))
+        # kernel paths gate at ANY severity (zero unproven is the
+        # contract), and the whole report must still be ERROR-free so the
+        # SDML_LINT_INJECT drill trips this preflight too
+        kern_ok = (not gating
+                   and all(r.ok(args.fail_on or "error") for r in reports))
+        print(f"analysis --serve-kernel: {len(reports)} layouts "
+              f"{'kernel-clean' if kern_ok else 'FLAGGED'}")
+        ok &= kern_ok
 
     if args.fixtures:
         from simple_distributed_machine_learning_tpu.analysis.fixtures import (

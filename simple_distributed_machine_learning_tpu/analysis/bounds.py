@@ -442,6 +442,17 @@ class BoundsWalker:
             return self._while(eqn, env)
         if prim == "cond":
             return self._cond(eqn, env)
+        if prim == "get":
+            # Pallas ref read (SMEM scalar-prefetch deref in index maps):
+            # values drawn from the ref carry the ref's content interval
+            return [a] * n
+        if prim == "pallas_call":
+            # open the kernel box: index-map bounds proofs, write-race
+            # detection, tiling/dtype lint (analysis/kernels.py)
+            from simple_distributed_machine_learning_tpu.analysis import (
+                kernels,
+            )
+            return kernels.check_pallas_call(self, eqn, ins, env)
 
         if prim == "pjit" and len(ins) == 2:
             # jnp's floor_divide/remainder lower to div/rem plus a

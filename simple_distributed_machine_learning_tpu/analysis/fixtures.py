@@ -362,6 +362,132 @@ def clean_gather_before_use() -> Report:
     return _zero1_report(True, "fixture:clean_gather_before_use")
 
 
+# -- kernel-*: seeded Pallas kernel defects (analysis/kernels.py) ----------
+
+def _paged_kernel_report(table_hi_slack: int, layout: str,
+                         dh: int, bs: int, name: str) -> Report:
+    """Trace the REAL fused paged-attention kernel on synthetic shapes with
+    a block-table contract reaching ``n_blocks + table_hi_slack`` — slack 0
+    is the slots.py invariant (clean), slack 1 is a table that can point
+    one block past the pool (kernel-oob)."""
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.analysis import (
+        analyze,
+        spec,
+    )
+    from simple_distributed_machine_learning_tpu.ops.paged_attention import (
+        paged_attention,
+    )
+    S, H, K, NB, n_blocks = 2, 2, 1, 3, 5
+
+    def attend(q, kc, vc, tables, qpos):
+        return paged_attention(q, kc, vc, tables, qpos, block_size=bs,
+                               _layout=layout)
+
+    q = jax.ShapeDtypeStruct((S, H, K, dh), np.float32)
+    kv = jax.ShapeDtypeStruct((n_blocks + 1, H, bs, dh), np.float32)
+    return analyze(
+        attend, q, kv, kv,
+        spec((S, NB), np.int32, 0, n_blocks + table_hi_slack),
+        spec((S, K), np.int32, 0, NB * bs - 1),
+        name=name)
+
+
+def kernel_oob_index_map() -> Report:
+    """The fused kernel's K/V index map fed a block-table contract that can
+    reach one past the pool: the BlockSpec would stream a window outside
+    the backing buffer."""
+    return _paged_kernel_report(1, "natural", dh=8, bs=4,
+                                name="fixture:kernel_oob_index_map")
+
+
+def kernel_clean_paged() -> Report:
+    """The same kernel under the slots.py table invariant — every index
+    map proves in bounds (must be fully clean)."""
+    return _paged_kernel_report(0, "natural", dh=8, bs=4,
+                                name="fixture:kernel_clean_paged")
+
+
+def kernel_bad_tile() -> Report:
+    """The pre-fix small-head-dim layout at a TPU-realistic block size:
+    dh=4 in the 128-lane slot pads every K/V block 32x (the ROADMAP #2
+    hazard the 'packed' layout fixes)."""
+    return _paged_kernel_report(0, "natural", dh=4, bs=128,
+                                name="fixture:kernel_bad_tile")
+
+
+def kernel_packed_tile() -> Report:
+    """The fixed layout for the same shapes: block positions in the lane
+    slot, the small head dim padded <= 2x into sublanes (must be clean)."""
+    return _paged_kernel_report(0, "packed", dh=4, bs=128,
+                                name="fixture:kernel_packed_tile")
+
+
+def _grid_kernel_report(racing: bool, scratch_dtype, name: str) -> Report:
+    """A hand-built pallas_call over a parallel grid axis — ``racing``
+    collapses every cell's output window onto block 0 (what an autotuner
+    mutation that drops the output index silently does)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from simple_distributed_machine_learning_tpu.analysis import analyze
+    from simple_distributed_machine_learning_tpu.ops.flash_attention import (
+        _compiler_params,
+        pltpu,
+    )
+
+    def kern(x_ref, o_ref, acc_ref):
+        acc_ref[...] = x_ref[...].astype(acc_ref.dtype) * 2
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+    out_idx = (lambda i: (0, 0)) if racing else (lambda i: (i, 0))
+
+    def fn(x):
+        return pl.pallas_call(
+            kern,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((1, 128), out_idx),
+            out_shape=jax.ShapeDtypeStruct((4, 128), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((1, 128), scratch_dtype)],
+            compiler_params=_compiler_params("parallel"),
+            interpret=True,
+        )(x)
+
+    x = jax.ShapeDtypeStruct((4, 128), jnp.float32)
+    return analyze(fn, x, name=name)
+
+
+def kernel_grid_race() -> Report:
+    import jax.numpy as jnp
+    return _grid_kernel_report(True, jnp.float32,
+                               "fixture:kernel_grid_race")
+
+
+def kernel_clean_grid() -> Report:
+    import jax.numpy as jnp
+    return _grid_kernel_report(False, jnp.float32,
+                               "fixture:kernel_clean_grid")
+
+
+def kernel_f16_accumulator() -> Report:
+    """An online-softmax-style scratch accumulator allocated in f16: state
+    carried across grid iterations below f32 drifts from the dense path's
+    einsum promotion (the bit-exactness contract)."""
+    import jax.numpy as jnp
+    return _grid_kernel_report(False, jnp.float16,
+                               "fixture:kernel_f16_accumulator")
+
+
+def kernel_f32_accumulator() -> Report:
+    import jax.numpy as jnp
+    return _grid_kernel_report(False, jnp.float32,
+                               "fixture:kernel_f32_accumulator")
+
+
 # -- clean twin: a full pipeline train step must produce zero findings -----
 
 def clean_pipeline_step() -> Report:
@@ -420,6 +546,18 @@ FIXTURES: dict[str, Fixture] = {f.name: f for f in [
     Fixture("dropped_gather_before_use", "sharded-state", True,
             "ZeRO opt-state shard consumed without gather/reduce",
             dropped_gather_before_use),
+    Fixture("kernel_oob_index_map", "kernel-oob", True,
+            "fused paged kernel with a block-table contract past the pool",
+            kernel_oob_index_map),
+    Fixture("kernel_grid_race", "kernel-race", True,
+            "pallas output index map collapsing a parallel grid axis",
+            kernel_grid_race),
+    Fixture("kernel_bad_tile", "kernel-tile", True,
+            "small head dim in the 128-lane slot (32x Mosaic tile padding)",
+            kernel_bad_tile),
+    Fixture("kernel_f16_accumulator", "kernel-dtype-drift", True,
+            "f16 scratch accumulator carried across grid iterations",
+            kernel_f16_accumulator),
     Fixture("clean_grad_sync", "", False,
             "the dropped_grad_sync fixture with the pmean restored",
             clean_grad_sync),
@@ -432,12 +570,27 @@ FIXTURES: dict[str, Fixture] = {f.name: f for f in [
     Fixture("clean_pipeline_step", "", False,
             "a 2-stage dp=2 GPipe train step (must be clean)",
             clean_pipeline_step),
+    Fixture("kernel_clean_paged", "", False,
+            "the fused paged kernel under the slots.py table invariant",
+            kernel_clean_paged),
+    Fixture("kernel_clean_grid", "", False,
+            "the grid kernel with its output indexed by the parallel axis",
+            kernel_clean_grid),
+    Fixture("kernel_packed_tile", "", False,
+            "the small-head-dim kernel in the fixed 'packed' layout",
+            kernel_packed_tile),
+    Fixture("kernel_f32_accumulator", "", False,
+            "the grid kernel with its scratch accumulator in f32",
+            kernel_f32_accumulator),
 ]}
 
 
 def self_test() -> tuple[bool, str]:
-    """Run every fixture against its contract. Returns (ok, report_text) —
-    the CLI ``--fixtures`` mode prints the text and exits 0 iff ok."""
+    """Run every fixture against its contract, plus the chaos drill
+    coverage lint (``resilience.faults.drill_coverage``: every registered
+    fault kind x site fired by at least one test/CI drill). Returns
+    (ok, report_text) — the CLI ``--fixtures`` mode prints the text and
+    exits 0 iff ok."""
     lines = []
     ok = True
     for fx in FIXTURES.values():
@@ -451,4 +604,14 @@ def self_test() -> tuple[bool, str]:
         want = (f"must flag [{fx.family}]" if fx.defect else "must be clean")
         lines.append(f"== {fx.name}: {want} -> {verdict}")
         lines.append(report.format(costs=False))
+    from simple_distributed_machine_learning_tpu.resilience.faults import (
+        drill_coverage,
+    )
+    gaps = drill_coverage()
+    verdict = "OK" if not gaps else "COVERAGE GAPS"
+    lines.append(f"== fault drill coverage: every kind x site fired "
+                 f"-> {verdict}")
+    for g in gaps:
+        lines.append(f"  MISSING: {g}")
+        ok = False
     return ok, "\n".join(lines)

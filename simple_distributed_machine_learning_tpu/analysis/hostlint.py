@@ -18,7 +18,14 @@ catch with ``ast`` and expensive to catch in production:
 - ``hostlint.raw-jit-in-serve`` — a ``jax.jit`` created inside ``serve/``:
   the serving layer's contract is that every compiled program comes from
   the memoized gpt builders, so a stray jit there is an unmemoized program
-  by construction.
+  by construction;
+- ``hostlint.wall-clock-in-serve`` — a wall-clock or RNG CALL inside
+  ``serve/`` (``time.time``/``monotonic``/``perf_counter``,
+  ``datetime.now``, ``random.*``): the exact-pinned scenario suite and the
+  journal-replay determinism contract (PRs 10-11) hold ONLY because every
+  clock read goes through the injectable plumbing (``clock=`` default
+  args, the simulator's VirtualClock) — referencing ``time.monotonic`` as
+  a default is sanctioned, calling it inline is not.
 
 Pure ``ast`` — no jax import, so the CI lint job runs it in milliseconds:
 ``python -m simple_distributed_machine_learning_tpu.analysis --hostlint``.
@@ -93,6 +100,67 @@ def _jit_bindings(tree) -> tuple[set, set]:
     return jax_aliases, jit_names
 
 
+#: wall-clock readers in the ``time`` module (sleep excluded: it consumes
+#: time rather than reads it, and the simulator injects it explicitly)
+_WALLCLOCK_TIME_FNS = ("time", "monotonic", "perf_counter", "time_ns",
+                       "monotonic_ns", "perf_counter_ns")
+_WALLCLOCK_DT_FNS = ("now", "utcnow", "today")
+
+
+def _clock_bindings(tree) -> tuple[set, set, set, set]:
+    """Names a module binds to the time/datetime/random modules and to
+    wall-clock functions imported from them, mirroring ``_jit_bindings``'s
+    alias resolution so every spelling is caught."""
+    time_a, dt_a, rand_a, direct = set(), set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_a.add(a.asname or "time")
+                elif a.name == "datetime":
+                    dt_a.add(a.asname or "datetime")
+                elif a.name == "random":
+                    rand_a.add(a.asname or "random")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for a in node.names:
+                    if a.name in _WALLCLOCK_TIME_FNS:
+                        direct.add(a.asname or a.name)
+            elif node.module == "datetime":
+                for a in node.names:
+                    if a.name in ("datetime", "date"):
+                        dt_a.add(a.asname or a.name)
+            elif node.module == "random":
+                for a in node.names:
+                    direct.add(a.asname or a.name)
+    return time_a, dt_a, rand_a, direct
+
+
+def _wallclock_call(call: ast.Call, bindings) -> str | None:
+    """The dotted name of a wall-clock/RNG read this Call performs, or
+    None. Only CALLS count — ``clock=time.monotonic`` default-arg
+    REFERENCES are the sanctioned injection points."""
+    time_a, dt_a, rand_a, direct = bindings
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in direct:
+        return f.id
+    if isinstance(f, ast.Attribute):
+        root = f.value
+        if isinstance(root, ast.Name):
+            if root.id in time_a and f.attr in _WALLCLOCK_TIME_FNS:
+                return f"{root.id}.{f.attr}"
+            if root.id in rand_a:
+                return f"{root.id}.{f.attr}"
+            if root.id in dt_a and f.attr in _WALLCLOCK_DT_FNS:
+                return f"{root.id}.{f.attr}"
+        if (isinstance(root, ast.Attribute)
+                and isinstance(root.value, ast.Name)
+                and root.value.id in dt_a
+                and f.attr in _WALLCLOCK_DT_FNS):
+            return f"{root.value.id}.{root.attr}.{f.attr}"
+    return None
+
+
 def _is_jax_jit(node, jax_aliases: set, jit_names: set) -> bool:
     """A jit reference in any spelling (covers ``jax.jit(...)``,
     ``@jax.jit``, ``functools.partial(jax.jit, ...)`` operands, and the
@@ -145,6 +213,7 @@ def _lint_call_sites(path: str, allow_jit: bool,
         tree = ast.parse(f.read(), filename=path)
     findings: list[Finding] = []
     jax_aliases, jit_names = _jit_bindings(tree)
+    clock_bindings = _clock_bindings(tree)
     for node in ast.walk(tree):
         if (isinstance(node, (ast.Name, ast.Attribute))
                 and (node.id if isinstance(node, ast.Name) else node.attr)
@@ -170,6 +239,22 @@ def _lint_call_sites(path: str, allow_jit: bool,
                     where=_where(path, node, repo),
                     hint=f"call the public "
                          f"make{name[len('_build'):]} instead"))
+            if not allow_jit:
+                clock = _wallclock_call(node, clock_bindings)
+                if clock is not None:
+                    findings.append(Finding(
+                        rule="hostlint.wall-clock-in-serve",
+                        severity=Severity.ERROR,
+                        message=(f"'{clock}()' called inside serve/ — the "
+                                 f"exact-pinned scenarios and journal "
+                                 f"replay are deterministic ONLY because "
+                                 f"every clock/RNG read goes through the "
+                                 f"injectable plumbing"),
+                        where=_where(path, node, repo),
+                        hint="take the clock as an injectable default arg "
+                             "(clock=time.monotonic) or use the "
+                             "simulator's VirtualClock; seed randomness "
+                             "explicitly"))
         if not allow_jit and _is_jax_jit(node, jax_aliases, jit_names):
             findings.append(Finding(
                 rule="hostlint.raw-jit-in-serve", severity=Severity.ERROR,

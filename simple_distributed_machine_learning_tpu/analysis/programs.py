@@ -758,6 +758,7 @@ def lint_serve(stages, sspec: ServeSpec, name: str | None = None,
                      + (f" spec_k={sspec.spec_k}" if sspec.spec_k
                         else "") + "]")
     report = Report(name=label, findings=list(policy))
+    kernel_rows: list[HBMCost] = []
     for prog in programs:
         sub = analyze(prog.fn, *prog.args, mesh=mesh,
                       name=f"{label}:{prog.name}")
@@ -766,9 +767,66 @@ def lint_serve(stages, sspec: ServeSpec, name: str | None = None,
                 f, where=f"{prog.name}: {f.where}" if f.where
                 else prog.name))
         report.costs.extend(sub.costs)
-    report.hbm.extend(hbm_tick_costs(sspec, n_layers=n_layers))
+        # kernel-derived HBM rows (analysis/kernels.py): what the traced
+        # pallas_calls' own BlockSpecs say the program streams
+        kernel_rows.extend(dataclasses.replace(h, program=prog.name)
+                           for h in sub.hbm)
+    report.hbm.extend(kernel_rows)
+    model_rows = hbm_tick_costs(sspec, n_layers=n_layers)
+    report.hbm.extend(model_rows)
+    report.findings.extend(
+        _reconcile_kernel_hbm(kernel_rows, model_rows, sspec))
     report.findings.extend(_injected_findings())
     return report
+
+
+def _reconcile_kernel_hbm(kernel_rows: list[HBMCost],
+                          model_rows: list[HBMCost],
+                          sspec: ServeSpec) -> list[Finding]:
+    """Cross-check the kernel-DERIVED K/V stream bytes (block shapes x the
+    grid trips each index map depends on, from the traced pallas_calls)
+    against the hand-built tick model's gather rows. The fused kernel's
+    whole value claim — it deletes the 2x ``kv_attn_reread`` pass, reading
+    resident K/V exactly once per tick — must be computed from the
+    kernel's own BlockSpecs, not asserted: the two totals agree EXACTLY or
+    the registry gate fails."""
+    if sspec.attn_kernel != "fused":
+        return []
+    derived: dict[str, int] = {}
+    for h in kernel_rows:
+        if h.op == "kernel.kv_stream":
+            derived[h.program] = derived.get(h.program, 0) + h.bytes_per_tick
+    model = {(m.program, m.op): m.bytes_per_tick for m in model_rows}
+    out: list[Finding] = []
+    for prog, op in (("paged_decode", "decode.kv_gather"),
+                     ("paged_verify", "verify.kv_gather")):
+        want = model.get((prog, op))
+        if want is None:
+            continue
+        got = derived.get(prog)
+        if got is None:
+            out.append(Finding(
+                rule="kernel-hbm.mismatch", severity=Severity.ERROR,
+                message=(f"attn_kernel='fused' but no pallas_call K/V "
+                         f"stream was traced in {prog} — the registry "
+                         f"linted a program that is not running the "
+                         f"kernel it claims"),
+                where=prog,
+                hint="the engine/registry builder dropped the fused "
+                     "kernel path; rebuild with kernel='fused' plumbed "
+                     "through"))
+        elif got != want:
+            out.append(Finding(
+                rule="kernel-hbm.mismatch", severity=Severity.ERROR,
+                message=(f"{prog}: the traced kernels' BlockSpecs stream "
+                         f"{got} K/V bytes/tick but the HBM tick model's "
+                         f"{op} row says {want} — the fused single-pass "
+                         f"claim (the deleted kv_attn_reread) no longer "
+                         f"matches the kernel itself"),
+                where=prog,
+                hint="hbm_tick_costs and the kernel BlockSpecs are one "
+                     "contract: fix whichever drifted"))
+    return out
 
 
 def default_registry_reports() -> list[Report]:
@@ -789,9 +847,12 @@ def default_registry_reports() -> list[Report]:
     draft_cfg = _dc.replace(cfg, n_layers=1)
     draft_stages, _, _ = make_gpt_stages(jax.random.key(1), draft_cfg, 1)
     buckets = (4, 8, 12)
+    # the speculative paged layout runs the FUSED verify kernel (the
+    # K-token variant of paged attention) so the registry sweep lints —
+    # and HBM-reconciles — both fused tick shapes, not just K=1 decode
     spec_paged = ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
                            prefill_chunk=3, prompt_lens=buckets, spec_k=4,
-                           draft_cfg=draft_cfg)
+                           draft_cfg=draft_cfg, attn_kernel="fused")
     specs = [
         ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
                   prefill_chunk=3, prompt_lens=buckets),

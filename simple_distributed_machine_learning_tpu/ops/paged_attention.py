@@ -65,15 +65,18 @@ from simple_distributed_machine_learning_tpu.ops.flash_attention import (
 
 
 def _paged_attn_kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, *rest,
-                       bs: int, n_q: int, scale: float, quant: bool):
+                       bs: int, n_q: int, scale: float, quant: bool,
+                       packed: bool):
     """One (slot, k-block) grid cell; k-block innermost carries the
     online-softmax state.
 
     ``q_ref``: [1, H, K, dh] (this slot's queries, all heads);
     ``k_ref``/``v_ref``: [1, H, bs, dh] — the PHYSICAL block the index map
-    dereferenced through the slot's table; with ``quant``, ``ks_ref``/
-    ``vs_ref``: [1, H, bs] per-row dequant scales of the same block;
-    ``o_ref``: [1, H, K, dh] f32. Scratch: ``acc`` [H, K, dh] f32 and the
+    dereferenced through the slot's table (``packed``: [1, H, dh, bs], the
+    block positions living in the 128-lane slot so a small head dim pads
+    to sublanes, not lanes); with ``quant``, ``ks_ref``/``vs_ref``:
+    [1, H, bs] per-row dequant scales of the same block; ``o_ref``:
+    [1, H, K, dh] f32. Scratch: ``acc`` [H, K, dh] f32 and the
     lane-broadcast ``l``/``m`` [H, K, _LANES] f32 (flash_attention's
     scratch idiom)."""
     if quant:
@@ -97,14 +100,17 @@ def _paged_attn_kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, *rest,
         # per-query positions of this slot (K is static and small)
         qp = jnp.stack([qpos_ref[s_idx, j] for j in range(n_q)])
         q = q_ref[0].astype(jnp.float32)                  # [H, K, dh]
-        k = k_ref[0].astype(jnp.float32)                  # [H, bs, dh]
+        k = k_ref[0].astype(jnp.float32)      # [H, bs, dh] / packed [H, dh, bs]
         v = v_ref[0].astype(jnp.float32)
         if quant:
-            k = k * ks_ref[0][..., None]
-            v = v * vs_ref[0][..., None]
+            scl = (ks_ref[0][:, None, :], vs_ref[0][:, None, :]) \
+                if packed else (ks_ref[0][..., None], vs_ref[0][..., None])
+            k = k * scl[0]
+            v = v * scl[1]
         # scores in f32 — the dense path's einsum promotion, so the fused
         # logits track the gather-then-dense ones to ulps
-        s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,)))) * scale
+        kdim = 1 if packed else 2
+        s = lax.dot_general(q, k, (((2,), (kdim,)), ((0,), (0,)))) * scale
         kpos = kb * bs + lax.broadcasted_iota(jnp.int32, (1, n_q, bs), 2)
         mask = kpos <= qp[None, :, None]                  # [1, K, bs]
         s = jnp.where(mask, s, NEG_INF)                   # [H, K, bs]
@@ -114,9 +120,10 @@ def _paged_attn_kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, *rest,
         p = jnp.exp(s - m_new[..., None])
         p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
+        vdim = 2 if packed else 1
         acc_scr[...] = (acc_scr[...] * corr[..., None]
                         + lax.dot_general(p, v,
-                                          (((2,), (1,)), ((0,), (0,)))))
+                                          (((2,), (vdim,)), ((0,), (0,)))))
         l_scr[...] = jnp.broadcast_to(
             (l_prev * corr + p.sum(axis=2))[..., None], l_scr.shape)
         m_scr[...] = jnp.broadcast_to(m_new[..., None], m_scr.shape)
@@ -128,10 +135,15 @@ def _paged_attn_kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, *rest,
                     / jnp.maximum(l, 1e-30)[..., None]).astype(o_ref.dtype)
 
 
+#: f32 sublane quantum — the ``packed`` layout pads the head dim to this
+_SUBLANES = 8
+
+
 def paged_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
                     tables: jax.Array, qpos: jax.Array, *,
                     block_size: int, kscale: jax.Array | None = None,
-                    vscale: jax.Array | None = None) -> jax.Array:
+                    vscale: jax.Array | None = None,
+                    _layout: str = "auto") -> jax.Array:
     """Fused paged attention over one layer's physical block pool.
 
     ``q``: [S, H, K, dh] queries (K = 1 for the flash-decode tick, the
@@ -146,6 +158,21 @@ def paged_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
     softmax-attention einsum pair produces over the gathered span, with
     rows past each query's position masked out (trash-table entries
     included, same as the dense mask).
+
+    ``_layout`` picks how K/V blocks meet Mosaic's (sublane, lane) tiles:
+
+    - ``"natural"`` — blocks stream as stored, ``[1, H, bs, dh]`` with the
+      head dim in the 128-lane slot. Fine when ``dh`` is a lane multiple;
+      a small head dim pads every block up to 128 lanes (the ROADMAP #2
+      hazard the ``kernel-tile.pad-waste`` lint flags).
+    - ``"packed"`` — K/V blocks are transposed once on the host to
+      ``[1, H, dh', bs]`` (``dh'`` = ``dh`` rounded up to the f32 sublane
+      quantum, 8): block positions take the lane slot, the small head dim
+      pads at most 2x into sublanes instead of up to 32x into lanes. The
+      zero-padded rows contribute nothing to either dot, so the math is
+      identical to ``"natural"``.
+    - ``"auto"`` (default) — ``natural`` when ``dh`` is a lane multiple or
+      in interpret mode (no tiling there), else ``packed``.
     """
     if not _HAS_PLTPU:  # pragma: no cover
         raise RuntimeError("paged_attention needs jax.experimental.pallas."
@@ -158,16 +185,28 @@ def paged_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
     quant = kscale is not None
     if quant != (vscale is not None):
         raise ValueError("pass both kscale and vscale, or neither")
+    if _layout not in ("auto", "natural", "packed"):
+        raise ValueError(f"_layout must be auto/natural/packed, "
+                         f"got {_layout!r}")
     scale = 1.0 / math.sqrt(dh)
     interpret = _interpret()
-    if not interpret and dh % _LANES:  # pragma: no cover - TPU-only path
-        # Mosaic wants a 128-lane head dim; pad (a copy — deploy dh in
-        # lane multiples to avoid it; interpret mode needs no padding)
-        pad = [(0, 0)] * 3 + [(0, (-dh) % _LANES)]
-        q = jnp.pad(q, [(0, 0), (0, 0), (0, 0), pad[3]])
-        kc = jnp.pad(kc, pad)
-        vc = jnp.pad(vc, pad)
-    dp = q.shape[-1]
+    layout = _layout
+    if layout == "auto":
+        layout = ("natural" if interpret or dh % _LANES == 0
+                  else "packed")
+    packed = layout == "packed"
+    dp = dh
+    if packed:
+        dp = dh + (-dh) % _SUBLANES
+        if dp != dh:
+            pad = [(0, 0)] * 3 + [(0, dp - dh)]
+            q = jnp.pad(q, pad)
+            kc = jnp.pad(kc, pad)
+            vc = jnp.pad(vc, pad)
+        # one host-side transpose per tick ([..., bs, dh'] -> [..., dh', bs])
+        # beats the old pad-to-128-lanes copy (<= 2x bytes vs up to 32x)
+        kc = jnp.swapaxes(kc, -1, -2)
+        vc = jnp.swapaxes(vc, -1, -2)
 
     def _kv_idx(s, kb, tables_ref, qpos_ref):
         # past-the-end fetch elision: clamp at the newest query's block so
@@ -182,10 +221,11 @@ def paged_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
         last = qpos_ref[s, K - 1] // bs
         return (tables_ref[s, jnp.minimum(kb, last)], 0, 0)
 
+    kv_block = (1, H, dp, bs) if packed else (1, H, bs, dp)
     in_specs = [
         pl.BlockSpec((1, H, K, dp), _q_idx),
-        pl.BlockSpec((1, H, bs, dp), _kv_idx),
-        pl.BlockSpec((1, H, bs, dp), _kv_idx),
+        pl.BlockSpec(kv_block, _kv_idx),
+        pl.BlockSpec(kv_block, _kv_idx),
     ]
     operands = [q, kc, vc]
     if quant:
@@ -207,7 +247,7 @@ def paged_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_paged_attn_kernel, bs=bs, n_q=K, scale=scale,
-                          quant=quant),
+                          quant=quant, packed=packed),
         grid_spec=grid_spec,
         out_shape=_struct((S, H, K, dp), jnp.float32, vma),
         # slots are independent; the k-block axis carries scratch state

@@ -444,3 +444,70 @@ def check(site: str, only=None, exclude=(), **ctx) -> list[FaultSpec]:
     if _ACTIVE is None:
         return []
     return _ACTIVE.check(site, only=only, exclude=exclude, **ctx)
+
+
+# -- drill coverage lint ----------------------------------------------------
+
+def drill_coverage(root: str | None = None, kinds=None, sites=None,
+                   pairs=None) -> list[str]:
+    """The chaos-coverage lint: every registered fault kind and every
+    instrumented site must be FIRED by at least one test or CI drill, and
+    every pinned kind<->site pair (``_KIND_SITE``) must be drilled as that
+    exact pair — a new kind/site added without a drill currently passes
+    vacuously, which is the one failure mode a deterministic chaos harness
+    cannot tolerate. Scans ``tests/*.py`` and ``.github/workflows/*.yml``
+    for the ``kind@site`` schedule grammar and keyword ``FaultSpec(...)``
+    constructions. Returns a list of human-readable gaps (empty = fully
+    covered); the analysis CLI's ``--fixtures`` self-test runs it as an
+    extra contract line."""
+    import re
+
+    kinds = tuple(kinds if kinds is not None else KINDS)
+    sites = tuple(sites if sites is not None else SITES)
+    pairs = dict(pairs if pairs is not None else _KIND_SITE)
+    if root is None:
+        root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                            os.pardir, os.pardir))
+    texts = []
+    for sub in ("tests", os.path.join(".github", "workflows")):
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if fname.endswith((".py", ".yml", ".yaml")):
+                try:
+                    with open(os.path.join(d, fname),
+                              encoding="utf-8") as fh:
+                        texts.append(fh.read())
+                except OSError:
+                    continue
+    blob = "\n".join(texts)
+    fired: set[tuple[str, str]] = set()
+    # the FaultPlan.parse schedule grammar: kind@site[=step][...]
+    for m in re.finditer(r"([a-z][a-z0-9-]*)@([a-z][a-z0-9.]*)", blob):
+        k, s = m.group(1), m.group(2)
+        if k in kinds and s in sites:
+            fired.add((k, s))
+    # keyword FaultSpec(...) constructions (tests that build plans in code)
+    for m in re.finditer(r"FaultSpec\(([^)]*)\)", blob):
+        body = m.group(1)
+        km = re.search(r"kind\s*=\s*['\"]([a-z0-9-]+)['\"]", body)
+        sm = re.search(r"site\s*=\s*['\"]([a-z0-9.]+)['\"]", body)
+        if km and sm and km.group(1) in kinds and sm.group(1) in sites:
+            fired.add((km.group(1), sm.group(1)))
+    gaps: list[str] = []
+    fired_kinds = {k for k, _ in fired}
+    fired_sites = {s for _, s in fired}
+    for k in kinds:
+        if k not in fired_kinds:
+            gaps.append(f"fault kind {k!r} is registered but no test/CI "
+                        f"drill ever fires it")
+    for s in sites:
+        if s not in fired_sites:
+            gaps.append(f"fault site {s!r} is instrumented but no test/CI "
+                        f"drill ever fires it")
+    for k, s in pairs.items():
+        if k in kinds and s in sites and (k, s) not in fired:
+            gaps.append(f"pinned pair {k}@{s} (the kind's sole "
+                        f"interpreting site) is never drilled as that pair")
+    return gaps

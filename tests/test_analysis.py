@@ -60,6 +60,8 @@ def _train_report(pipe, batch, in_dim, opt=None):
     "bf16_psum_accumulator", "read_after_donate",
     "oob_block_table", "cow_read_after_donate", "unmemoized_retrace",
     "dropped_gather_before_use",
+    "kernel_oob_index_map", "kernel_grid_race", "kernel_bad_tile",
+    "kernel_f16_accumulator",
 ])
 def test_seeded_defect_is_flagged(name):
     fx = FIXTURES[name]
@@ -92,11 +94,21 @@ def test_new_family_defect_severities():
     for name in ("oob_block_table", "cow_read_after_donate",
                  "unmemoized_retrace", "dropped_gather_before_use"):
         assert FIXTURES[name].build().errors, name
+    # inside the kernel box: a provably-escaping index map and a parallel
+    # write race are ERRORs; tiling waste and a sub-f32 scratch accumulator
+    # are WARNINGs (real, but an autotuner candidate may accept them)
+    for name in ("kernel_oob_index_map", "kernel_grid_race"):
+        assert FIXTURES[name].build().errors, name
+    for name in ("kernel_bad_tile", "kernel_f16_accumulator"):
+        report = FIXTURES[name].build()
+        assert not report.errors and report.warnings, report.format()
 
 
 def test_clean_fixtures_pass():
     for name in ("clean_grad_sync", "clean_pipeline_step",
-                 "clean_cow_tick", "clean_gather_before_use"):
+                 "clean_cow_tick", "clean_gather_before_use",
+                 "kernel_clean_paged", "kernel_clean_grid",
+                 "kernel_packed_tile", "kernel_f32_accumulator"):
         report = FIXTURES[name].build()
         assert report.ok(fail_on="warning"), report.format()
 
@@ -339,4 +351,6 @@ def test_severity_ordering_and_families():
     fams = {fx.family for fx in FIXTURES.values() if fx.defect}
     assert fams == {"ppermute-deadlock", "unreduced-gradient", "mesh-axis",
                     "dtype-drift", "donation", "scatter-bounds",
-                    "retrace-explosion", "sharded-state"}
+                    "retrace-explosion", "sharded-state",
+                    "kernel-oob", "kernel-race", "kernel-tile",
+                    "kernel-dtype-drift"}

@@ -693,3 +693,195 @@ def test_default_registry_includes_clean_degraded_entry():
     rules = {f.rule for f in r.findings}
     assert "trace.failed" not in rules
     assert "scatter-bounds.unproven-promise" not in rules
+
+
+# ---- ISSUE 16: inside the kernel box ------------------------------------
+#
+# The pallas_call rule family (analysis/kernels.py). The serve registry
+# already proves the REAL kernels clean above; here the corners of the
+# index-map interval arithmetic, the scalar-prefetch contract seeding and
+# the hostlint/fault-drill satellites get their own pins.
+
+
+def _lint_pallas(kernel, grid, in_specs, out_specs, out_shape, *args,
+                 **contracts):
+    from jax.experimental import pallas as pl
+
+    def prog(*a):
+        return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                              out_specs=out_specs, out_shape=out_shape,
+                              interpret=True, **contracts.pop("pl_kw", {}))(*a)
+
+    return analyze(prog, *args, name="kernel_corner")
+
+
+def test_kernel_floordiv_and_rem_index_maps_prove_clean():
+    """i//2 and i%3 over grid axes: the interval corners PR 8 pinned on
+    gather indices must also carry proofs THROUGH BlockSpec index maps —
+    both derived maps stay inside a (3, 6) block grid for grid=(6,)."""
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] + y_ref[...]
+
+    x = jax.ShapeDtypeStruct((3, 8), np.float32)   # blocks (1,8), rows i//2
+    y = jax.ShapeDtypeStruct((3, 8), np.float32)   # rows i%3
+    report = _lint_pallas(
+        kern, (6,),
+        [pl.BlockSpec((1, 8), lambda i: (i // 2, 0)),
+         pl.BlockSpec((1, 8), lambda i: (i % 3, 0))],
+        pl.BlockSpec((1, 8), lambda i: (i % 3, 0)),
+        jax.ShapeDtypeStruct((3, 8), np.float32),
+        x, y)
+    bad = [f for f in report.findings if f.family.startswith("kernel-")]
+    assert not bad, report.format()
+
+
+def test_kernel_oob_floordiv_index_map_is_proved_escaping():
+    """grid=(8,) with rows i//2 over a 3-row operand REACHES row 3: a
+    finite counterexample, so kernel-oob (ERROR), not merely unproven."""
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    report = _lint_pallas(
+        kern, (8,),
+        [pl.BlockSpec((1, 8), lambda i: (i // 2, 0))],
+        pl.BlockSpec((1, 8), lambda i: (i % 8, 0)),
+        jax.ShapeDtypeStruct((8, 8), np.float32),
+        jax.ShapeDtypeStruct((3, 8), np.float32))
+    assert any(f.rule == "kernel-oob.index-map" for f in report.findings), (
+        report.format())
+    assert not report.ok()
+
+
+def test_kernel_scalar_prefetch_contract_seeds_the_proof():
+    """A PrefetchScalarGridSpec block-table deref is only provable when the
+    caller DECLARES the table's range (analysis.spec): with the contract
+    the map proves clean, without it the same kernel is kernel-unproven —
+    never silently ok."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kern(tbl_ref, x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def prog(tbl, x):
+        gspec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(4,),
+            in_specs=[pl.BlockSpec((1, 8), lambda i, tbl: (tbl[i], 0))],
+            out_specs=pl.BlockSpec((1, 8), lambda i, tbl: (i, 0)))
+        return pl.pallas_call(
+            kern, grid_spec=gspec, interpret=True,
+            out_shape=jax.ShapeDtypeStruct((4, 8), np.float32))(tbl, x)
+
+    x = jax.ShapeDtypeStruct((5, 8), np.float32)
+    proven = analyze(prog, spec((4,), np.int32, 0, 4), x)
+    assert not [f for f in proven.findings
+                if f.family.startswith("kernel-")], proven.format()
+    unproven = analyze(prog, jax.ShapeDtypeStruct((4,), np.int32), x)
+    assert any(f.rule == "kernel-unproven.index-map"
+               for f in unproven.findings), unproven.format()
+    # a contract that ADMITS escape is an ERROR, not just unproven
+    escaping = analyze(prog, spec((4,), np.int32, 0, 9), x)
+    assert any(f.rule == "kernel-oob.index-map"
+               for f in escaping.findings), escaping.format()
+
+
+def test_kernel_narrowing_cast_drops_the_proof():
+    """An i32->i8 cast inside the index map forgets the interval when the
+    grid axis provably overflows int8 (wrap semantics): the proof must
+    degrade to kernel-unproven, never claim clean. A grid that FITS the
+    narrow dtype keeps its proof — same contract PR 8 pinned on gather
+    indices, now through BlockSpec index maps."""
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def build(grid_n):
+        return _lint_pallas(
+            kern, (grid_n,),
+            [pl.BlockSpec((1, 8),
+                          lambda i: (i.astype(np.int8).astype(np.int32),
+                                     0))],
+            pl.BlockSpec((1, 8), lambda i: (i, 0)),
+            jax.ShapeDtypeStruct((grid_n, 8), np.float32),
+            jax.ShapeDtypeStruct((grid_n, 8), np.float32))
+
+    # [0, 299] wraps in int8 -> interval lost -> unproven
+    report = build(300)
+    assert any(f.rule == "kernel-unproven.index-map"
+               for f in report.findings), report.format()
+    # [0, 3] is representable -> interval survives -> proof holds
+    assert not [f for f in build(4).findings
+                if f.family.startswith("kernel-")]
+
+
+def test_serve_kernel_cli_gate():
+    from simple_distributed_machine_learning_tpu.analysis.__main__ import (
+        main,
+    )
+    assert main(["--serve-kernel"]) == 0
+    os.environ["SDML_LINT_INJECT"] = "drill"
+    try:
+        assert main(["--serve-kernel"]) == 1
+    finally:
+        del os.environ["SDML_LINT_INJECT"]
+
+
+# ---- satellite: wall-clock/random hostlint rule -------------------------
+
+
+def test_hostlint_flags_wallclock_and_random_in_serve(tmp_path):
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        _lint_call_sites,
+    )
+    bad = tmp_path / "clocky.py"
+    bad.write_text(
+        "import time\n"
+        "import random\n"
+        "from datetime import datetime\n"
+        "t0 = time.monotonic()\n"
+        "jitter = random.random()\n"
+        "stamp = datetime.now()\n")
+    rules = [f for f in _lint_call_sites(str(bad), allow_jit=False)
+             if f.rule == "hostlint.wall-clock-in-serve"]
+    assert len(rules) == 3, [f.message for f in rules]
+    # the sanctioned idiom — injectable default args REFERENCING the clock
+    # (no call) plus calls through the injected parameter — stays clean
+    good = tmp_path / "injected.py"
+    good.write_text(
+        "import time\n"
+        "def tick(clock=time.monotonic):\n"
+        "    return clock()\n")
+    assert not [f for f in _lint_call_sites(str(good), allow_jit=False)
+                if f.rule == "hostlint.wall-clock-in-serve"]
+
+
+# ---- satellite: fault-drill coverage lint -------------------------------
+
+
+def test_fault_drill_coverage_clean_and_detects_gaps(tmp_path):
+    from simple_distributed_machine_learning_tpu.resilience.faults import (
+        KINDS,
+        SITES,
+        drill_coverage,
+    )
+    # the repo itself: every kind and site fired somewhere in tests/ or CI
+    assert drill_coverage() == []
+    # a synthetic tree that only ever drills one pair
+    tree = tmp_path / "repo"
+    (tree / "tests").mkdir(parents=True)
+    (tree / "tests" / "test_x.py").write_text(
+        'SCENARIO = "slow-tick@serve.tick"\n')
+    gaps = drill_coverage(root=str(tree))
+    assert any("kind" in g and "host-kill" in g for g in gaps)
+    assert any("site" in g and "train.step" in g for g in gaps)
+    assert any("nan-grad@train.grad" in g for g in gaps)  # pinned pair
+    # injected kinds/sites localize the check (pure-unit path)
+    gaps = drill_coverage(root=str(tree), kinds=("slow-tick",),
+                          sites=("serve.tick",), pairs=())
+    assert gaps == []
+    assert "slow-tick" in KINDS and "serve.tick" in SITES

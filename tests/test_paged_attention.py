@@ -428,3 +428,106 @@ def test_fp8_cache_roundtrip_and_engine(stages):
                              cache_dtype=jnp.float8_e4m3fn,
                              attn_kernel="fused")
     assert dense == fused
+
+
+# ---- ISSUE 16: the packed small-head-dim layout + kernel-derived HBM ----
+
+@pytest.mark.parametrize("dh", [4, 8, 16])
+def test_packed_layout_matches_natural(dh):
+    """The 'packed' layout (K/V transposed so block positions take the
+    lane slot — the ROADMAP #2 small-head-dim fix) is numerically
+    identical to the natural layout: the zero-padded head rows contribute
+    nothing to either dot."""
+    key, kc, vc, tables, pos = _toy_pool(jax.random.key(3), dh=dh)
+    S, H = tables.shape[0], kc.shape[1]
+    q = jax.random.normal(key, (S, H, 2, dh))
+    qpos = np.stack([np.maximum(pos - 1, 0), pos], axis=1).astype(np.int32)
+    nat = paged_attention(q, kc, vc, tables, qpos, block_size=4,
+                          _layout="natural")
+    pak = paged_attention(q, kc, vc, tables, qpos, block_size=4,
+                          _layout="packed")
+    np.testing.assert_allclose(np.asarray(pak), np.asarray(nat),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_packed_layout_matches_natural_quantized():
+    key, kc, vc, tables, pos = _toy_pool(jax.random.key(4), dh=4)
+    kq, ks = _quantize_rows(kc, jnp.int8)
+    vq, vs = _quantize_rows(vc, jnp.int8)
+    S, H = tables.shape[0], kc.shape[1]
+    q = jax.random.normal(key, (S, H, 1, 4))
+    nat = paged_attention(q, kq, vq, tables, pos[:, None], block_size=4,
+                          kscale=ks, vscale=vs, _layout="natural")
+    pak = paged_attention(q, kq, vq, tables, pos[:, None], block_size=4,
+                          kscale=ks, vscale=vs, _layout="packed")
+    np.testing.assert_allclose(np.asarray(pak), np.asarray(nat),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_paged_attention_rejects_unknown_layout():
+    key, kc, vc, tables, pos = _toy_pool(jax.random.key(5))
+    q = jax.random.normal(key, (3, 2, 1, 16))
+    with pytest.raises(ValueError, match="_layout"):
+        paged_attention(q, kc, vc, tables, pos[:, None], block_size=4,
+                        _layout="sideways")
+
+
+@pytest.mark.parametrize("cache_dtype", [None, "int8"])
+def test_kernel_hbm_rows_reconcile_with_tick_model(stages, cache_dtype):
+    """ISSUE 16 acceptance: the kernel-DERIVED K/V stream bytes (block
+    shapes x grid trips, from the traced pallas_calls' own BlockSpecs)
+    agree EXACTLY with the tick model's ``decode.kv_gather`` row — which
+    equals the dense twin's ``kv_attn_reread`` delta (the pass the fused
+    kernel deletes)."""
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        ServeSpec,
+        hbm_tick_costs,
+        lint_serve,
+    )
+    sspec = ServeSpec(CFG, n_slots=2, kv_layout="paged", block_size=4,
+                      cache_dtype=cache_dtype, attn_kernel="fused",
+                      prompt_lens=(4,))
+    report = lint_serve(stages, sspec)
+    assert report.ok(fail_on="warning"), report.format()
+    derived = {}
+    for h in report.hbm:
+        if h.op == "kernel.kv_stream":
+            derived[h.program] = derived.get(h.program, 0) + h.bytes_per_tick
+    model = {(h.program, h.op): h.bytes_per_tick
+             for h in report.hbm if not h.op.startswith("kernel.")}
+    assert derived["paged_decode"] == model[("paged_decode",
+                                             "decode.kv_gather")]
+    # the dense twin pays the SAME bytes again as the attn reread: the
+    # kernel-derived stream equals that deleted delta exactly
+    dense = {h.op: h.bytes_per_tick
+             for h in hbm_tick_costs(dataclasses.replace(
+                 sspec, attn_kernel="dense"))}
+    assert derived["paged_decode"] == dense["decode.kv_attn_reread"]
+
+
+def test_kernel_hbm_mismatch_is_flagged():
+    """Seeded drift between the tick model and the traced kernels must
+    produce the kernel-hbm.mismatch ERROR (the reconciliation is a gate,
+    not a report)."""
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        ServeSpec,
+        _reconcile_kernel_hbm,
+        hbm_tick_costs,
+    )
+    from simple_distributed_machine_learning_tpu.analysis.report import (
+        HBMCost,
+    )
+    sspec = ServeSpec(CFG, n_slots=2, kv_layout="paged", block_size=4,
+                      attn_kernel="fused")
+    model = hbm_tick_costs(sspec)
+    want = next(h.bytes_per_tick for h in model
+                if h.op == "decode.kv_gather")
+    bad = [HBMCost("kernel.kv_stream", "paged_decode", want + 64)]
+    findings = _reconcile_kernel_hbm(bad, model, sspec)
+    assert any(f.rule == "kernel-hbm.mismatch" for f in findings)
+    # and a fused spec whose programs traced NO kernel at all is flagged
+    findings = _reconcile_kernel_hbm([], model, sspec)
+    assert any(f.rule == "kernel-hbm.mismatch" for f in findings)
+    # exact agreement is silent
+    good = [HBMCost("kernel.kv_stream", "paged_decode", want)]
+    assert not _reconcile_kernel_hbm(good, model, sspec)
