@@ -814,6 +814,17 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
                                             max_new=max_new,
                                             prompt_lens=prompt_lens,
                                             block_size=block_size)
+        # the ISSUE-17 rows: disaggregated prefill/decode pools vs the
+        # symmetric fleet (same burst, same replica count), and the host
+        # offload tier's prefix-cache win under HBM pressure
+        rows += _measure_disaggregation(stages, cfg,
+                                        n_requests=n_requests,
+                                        max_new=max_new,
+                                        prompt_lens=prompt_lens,
+                                        block_size=block_size)
+        rows += _measure_host_offload(stages, cfg,
+                                      n_requests=min(n_requests, 12),
+                                      block_size=block_size)
     if default_shape:
         with open(os.path.join(REPO, "benchmarks", "serving.json"),
                   "w") as f:
@@ -1365,6 +1376,160 @@ def _measure_fleet_availability(stages, cfg, n_requests: int, max_new: int,
         "affinity_hits": s.get("route_affinity_hits", 0),
         "faults_fired": plan.stats()["total_fired"],
         "wall_s": round(wall, 3),
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }]
+
+
+def _measure_disaggregation(stages, cfg, n_requests: int, max_new: int,
+                            prompt_lens: tuple, block_size: int,
+                            replicas: int = 4, prefill_replicas: int = 2,
+                            slots: int = 2) -> list:
+    """Disaggregated prefill/decode pools vs the symmetric fleet
+    (``serve/fleet.py``, ISSUE 17): the SAME burst of requests through the
+    same replica count both ways. In the symmetric fleet every slot is
+    shared between prefilling new arrivals and decoding old ones, so
+    lingering decodes block fresh prefills; disaggregated, the prefill
+    pool's slots free at end-of-prefill (the journal snap/adopt handoff
+    moves the request to the decode pool) and TTFT tracks prefill-pool
+    turnover only. The row reports TTFT p95 both ways plus the handoff
+    count; the exact-pinned virtual-clock gate lives in
+    ``resilience/scenarios.py::disagg-prefill-heavy``."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.serve import (
+        ServeFleet,
+        ServeMetrics,
+        engine_factory,
+    )
+
+    def run(n_prefill):
+        metrics = ServeMetrics()
+        tmpdir = tempfile.TemporaryDirectory(prefix="sdml-bench-disagg-")
+        try:
+            fleet = ServeFleet(
+                engine_factory(stages, cfg, n_slots=slots,
+                               kv_layout="paged", block_size=block_size,
+                               prefill_chunk=block_size, metrics=metrics),
+                tmpdir.name, n_replicas=replicas,
+                prefill_replicas=n_prefill, metrics=metrics)
+            rng = np.random.default_rng(0)
+            t0 = _time.perf_counter()
+            for i in range(n_requests):
+                fleet.submit(
+                    rng.integers(0, cfg.vocab,
+                                 prompt_lens[i % len(prompt_lens)]).astype(
+                                     np.int32),
+                    max_new_tokens=max_new)
+            fleet.drain()
+            fleet.close()
+            wall = _time.perf_counter() - t0
+        finally:
+            tmpdir.cleanup()
+        completed = sum(1 for r in fleet.requests.values()
+                        if r.state == "done")
+        return metrics.summary(), wall, fleet.handoffs, completed
+
+    sym, sym_wall, _, sym_done = run(0)
+    dis, dis_wall, handoffs, dis_done = run(prefill_replicas)
+    return [{
+        "config": "gpt_serve_disagg_prefill_decode",
+        "replicas": replicas, "prefill_replicas": prefill_replicas,
+        "n_slots": slots, "n_requests": n_requests,
+        "max_new_tokens": max_new,
+        "completed": dis_done, "completed_symmetric": sym_done,
+        "handoffs": handoffs,
+        "ttft_ms_p95": dis.get("ttft_ms_p95"),
+        "ttft_ms_p95_symmetric": sym.get("ttft_ms_p95"),
+        "tokens_per_sec": dis.get("tokens_per_sec"),
+        "tokens_per_sec_symmetric": sym.get("tokens_per_sec"),
+        "wall_s": round(dis_wall, 3),
+        "wall_s_symmetric": round(sym_wall, 3),
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }]
+
+
+def _measure_host_offload(stages, cfg, n_requests: int,
+                          block_size: int, slots: int = 2) -> list:
+    """The host offload tier's prefix-cache win under HBM pressure
+    (``serve/slots.py``, ISSUE 17): alternate hot-prefix requests with
+    prefix-less scans through a pool sized to ONE full sequence, with and
+    without the host tier. Each scan evicts the idle shared prefix; the
+    HBM-only pool discards it (the next hot request re-prefills from
+    scratch) while the tiered pool demotes it to host RAM and the router's
+    affinity probe starts the prefetch upload back at submit time. The
+    row pins the mechanism end to end: demotions, promotions, prefetch
+    hits and the device prefix-hit gap over the HBM-only baseline."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.serve import (
+        ServeFleet,
+        ServeMetrics,
+        engine_factory,
+    )
+
+    bs = block_size
+    prefix = np.arange(2 * bs, dtype=np.int32) % cfg.vocab
+    max_len = 6 * bs                   # the scan's full extent
+    n_blocks = 6                       # exactly one full sequence: maximal
+    #                                    pressure, every scan evicts
+
+    def run(host_blocks):
+        metrics = ServeMetrics()
+        tmpdir = tempfile.TemporaryDirectory(prefix="sdml-bench-host-")
+        try:
+            fleet = ServeFleet(
+                engine_factory(stages, cfg, n_slots=slots,
+                               kv_layout="paged", block_size=bs,
+                               n_blocks=n_blocks, max_len=max_len,
+                               prefill_chunk=bs,
+                               host_cache_blocks=host_blocks,
+                               metrics=metrics),
+                tmpdir.name, n_replicas=1, metrics=metrics)
+            rng = np.random.default_rng(0)
+            t0 = _time.perf_counter()
+            for i in range(n_requests):
+                if i % 2 == 0:         # hot: shared prefix + unique tail
+                    prompt = np.concatenate(
+                        [prefix,
+                         rng.integers(0, cfg.vocab, bs).astype(np.int32)])
+                    fleet.submit(prompt, max_new_tokens=bs)
+                else:                  # scan: prefix-less, pool-filling
+                    fleet.submit(
+                        rng.integers(0, cfg.vocab, 4 * bs).astype(np.int32),
+                        max_new_tokens=2 * bs)
+                fleet.drain()          # sequential: each scan's eviction
+                #                        lands before the next hot arrival
+            fleet.close()
+            wall = _time.perf_counter() - t0
+        finally:
+            tmpdir.cleanup()
+        return metrics.summary(), wall
+
+    base, base_wall = run(0)
+    tier, tier_wall = run(n_blocks)
+    return [{
+        "config": "gpt_serve_host_offload_prefix",
+        "n_slots": slots, "n_requests": n_requests,
+        "block_size": bs, "n_blocks": n_blocks,
+        "host_cache_blocks": n_blocks,
+        "prefix_hit_blocks": tier.get("prefix_hit_blocks", 0),
+        "prefix_hit_blocks_hbm_only": base.get("prefix_hit_blocks", 0),
+        "host_demotes": tier.get("host_demotes", 0),
+        "host_promotes": tier.get("host_promotes", 0),
+        "host_prefetch_hits": tier.get("host_prefetch_hits", 0),
+        "host_transfer_bytes": tier.get("host_transfer_bytes", 0),
+        "wall_s": round(tier_wall, 3),
+        "wall_s_hbm_only": round(base_wall, 3),
         "device_kind": jax.devices()[0].device_kind,
         "backend": jax.default_backend(),
     }]

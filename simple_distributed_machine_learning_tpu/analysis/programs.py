@@ -108,6 +108,12 @@ class ServeSpec:
     # paged-attention kernel's single pass) — the HBM model's per-tick
     # rows and the registry's built programs both key off it
     attn_kernel: str = "dense"
+    # the host-RAM offload tier (paged only — serve/slots.py): evicted
+    # prefix blocks demote to a host-side LRU of this many blocks instead
+    # of dying; 0 disables the tier (and the host rows of the HBM model).
+    # prefetch_ticks is the async host->HBM upload latency in engine ticks
+    host_cache_blocks: int = 0
+    prefetch_ticks: int = 1
 
     @property
     def tp(self) -> int:
@@ -625,6 +631,26 @@ def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
             "cow.block_copy", "paged_block_copy",
             L * sspec.block_size * row,
             note=f"per copy-on-write divergence, all layers{shard}"))
+        if sspec.host_cache_blocks:
+            # the host offload tier's transfer-bandwidth bill: one whole
+            # block (all layers, K+V, plus quantized scale planes — it IS
+            # the pool's bytes_per_block) crosses the HBM<->host boundary
+            # per demotion and per prefetch promotion. The pool's
+            # host_transfer_bytes_total counter advances by exactly this
+            # per move — predict_transfer_bytes reconciles it to zero
+            # drift (tests/test_disagg.py)
+            blk = kv_block_bytes(L, H // tp, sspec.block_size, dh,
+                                 sspec.cache_dtype)
+            out.append(HBMCost(
+                "offload.demote_copy", "host_offload", blk,
+                note=f"per HBM->host demotion: the evicted block, all "
+                     f"layers{shard} — an eviction that would otherwise "
+                     f"discard the prefix"))
+            out.append(HBMCost(
+                "offload.prefetch_upload", "host_offload", blk,
+                note=f"per host->HBM promotion: one async-prefetched "
+                     f"block, all layers{shard}, spread over "
+                     f"{sspec.prefetch_ticks} tick(s)"))
         if K >= 2:
             out.append(HBMCost(
                 "verify.kv_scatter", "paged_verify", S * L * K * row,
@@ -706,6 +732,45 @@ def predict_kv_bytes_resident(sspec: ServeSpec, rows_per_seq,
                                sspec.cache_dtype)
     blocks = sum(math.ceil(r / sspec.block_size) for r in rows_per_seq)
     return blocks * per_block
+
+
+def _host_block_bytes(sspec: ServeSpec, n_layers: int | None = None) -> int:
+    """One paged block's bytes for ``sspec`` — the pool's own
+    ``bytes_per_block`` (per shard; quantized scale planes included), the
+    unit both host-tier predictors below bill in."""
+    from simple_distributed_machine_learning_tpu.serve.slots import (
+        kv_block_bytes,
+    )
+    cfg = sspec.cfg
+    L = n_layers if n_layers is not None else cfg.n_layers
+    return kv_block_bytes(L, cfg.n_heads // sspec.tp, sspec.block_size,
+                          cfg.d_model // cfg.n_heads, sspec.cache_dtype)
+
+
+def predict_host_kv_bytes(sspec: ServeSpec, n_host_blocks: int,
+                          n_layers: int | None = None) -> int:
+    """Model of the pool's ``serve_host_bytes_resident`` gauge: bytes the
+    host-RAM offload tier pins for ``n_host_blocks`` demoted blocks. The
+    host tier stores whole blocks (the exact device layout, numpy-side),
+    so the model is blocks x ``bytes_per_block`` — and like
+    ``predict_kv_bytes_resident`` it must agree with the live gauge
+    EXACTLY: any drift is an offload-tier accounting leak
+    (tests/test_disagg.py pins drift == 0 mid-handoff, post-demote and
+    with a prefetch in flight)."""
+    return n_host_blocks * _host_block_bytes(sspec, n_layers)
+
+
+def predict_transfer_bytes(sspec: ServeSpec, n_blocks: int,
+                           n_layers: int | None = None) -> int:
+    """Model of the pool's ``serve_host_transfer_bytes_total`` counter:
+    every block crossing the HBM↔host boundary — demotions down,
+    prefetch promotions up — moves exactly ``bytes_per_block``
+    (quantized caches move the narrow data planes plus their f32 scales,
+    so int8 blocks cross at roughly half the f32 bill). ``n_blocks`` is
+    the move count (``host_demotes_total + host_promotes_total``); the
+    prediction must equal the live counter exactly, same discipline as
+    ``serve_kv_drift_bytes``."""
+    return n_blocks * _host_block_bytes(sspec, n_layers)
 
 
 # -- the one-call preflights -----------------------------------------------
@@ -904,7 +969,9 @@ def engine_spec(engine, prompt_lens: tuple | None = None) -> ServeSpec:
         cache_dtype=pool.kc.dtype, prompt_lens=prompt_lens,
         spec_k=engine.spec_k if engine.speculative else 0,
         draft_cfg=engine.draft_cfg,
-        attn_kernel=engine.attn_kernel)
+        attn_kernel=engine.attn_kernel,
+        host_cache_blocks=getattr(pool, "host_cache_blocks", 0),
+        prefetch_ticks=getattr(pool, "prefetch_ticks", 1))
 
 
 def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:

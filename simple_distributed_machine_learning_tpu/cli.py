@@ -252,6 +252,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "scale-out on sustained queue backlog (or paged "
                         "KV residency), drain-then-retire on idle "
                         "(serve/fleet.py::AutoscalePolicy)")
+    g.add_argument('--serve-prefill-replicas', type=int, default=0,
+                   metavar='N',
+                   help="with --serve-replicas: DISAGGREGATE the fleet — "
+                        "the first N replicas form the prefill pool (new "
+                        "requests board there only) and the rest the "
+                        "decode pool; every request hands off at "
+                        "end-of-prefill by the journal snap/adopt move "
+                        "(serve/fleet.py). Mutually exclusive with "
+                        "--serve-autoscale")
+    g.add_argument('--serve-host-blocks', type=int, default=0, metavar='N',
+                   help="with --serve-sim: host-RAM offload tier of N "
+                        "blocks per replica behind the paged KV pool — "
+                        "LRU-evicted prefix blocks demote to host instead "
+                        "of dying, and a router affinity hit on a "
+                        "host-resident prefix starts the async prefetch "
+                        "upload at routing time (serve/slots.py)")
+    g.add_argument('--serve-prefetch-ticks', type=int, default=1,
+                   metavar='T',
+                   help="with --serve-host-blocks: engine ticks one "
+                        "host->HBM prefetch upload takes (the modeled "
+                        "PCIe/DMA latency; boarding blocks until the "
+                        "upload lands)")
     g.add_argument('--serve-trace', action='store_true',
                    help="with --serve-sim/--scenario and --telemetry-dir: "
                         "request-scoped tracing (serve/tracing.py) — a "
@@ -849,6 +871,25 @@ def _run_serve(args, n_stages: int, key) -> None:
             raise SystemExit(
                 f"--serve-replicas {args.serve_replicas} outside the "
                 f"--serve-autoscale bounds [{lo}, {hi}]")
+    if args.serve_prefill_replicas:
+        if not args.serve_replicas:
+            raise SystemExit("--serve-prefill-replicas needs "
+                             "--serve-replicas (pools split a fleet)")
+        if not 0 < args.serve_prefill_replicas < args.serve_replicas:
+            raise SystemExit(
+                f"--serve-prefill-replicas must leave at least one decode "
+                f"replica (0 < N < {args.serve_replicas}), got "
+                f"{args.serve_prefill_replicas}")
+        if args.serve_autoscale:
+            raise SystemExit("--serve-prefill-replicas and "
+                             "--serve-autoscale are mutually exclusive "
+                             "(the autoscaler assumes one symmetric pool)")
+    if args.serve_host_blocks < 0:
+        raise SystemExit(f"--serve-host-blocks must be >= 0 (0 = no host "
+                         f"tier), got {args.serve_host_blocks}")
+    if args.serve_prefetch_ticks < 1:
+        raise SystemExit(f"--serve-prefetch-ticks must be >= 1, got "
+                         f"{args.serve_prefetch_ticks}")
     serve_plan = None
     if args.serve_chaos:
         from simple_distributed_machine_learning_tpu.resilience import (
@@ -978,6 +1019,8 @@ def _run_serve(args, n_stages: int, key) -> None:
         params=params, n_slots=args.serve_slots,
         block_size=args.serve_block_size,
         prefill_chunk=(args.serve_prefill_chunk or None),
+        host_cache_blocks=args.serve_host_blocks,
+        prefetch_ticks=args.serve_prefetch_ticks,
         metrics=metrics, mesh=mesh, draft_stages=draft_stages,
         draft_cfg=draft_cfg, spec_k=args.serve_spec_k)
     tmpdir = None
@@ -998,7 +1041,9 @@ def _run_serve(args, n_stages: int, key) -> None:
             journal_dir = tmpdir.name
         engine = ServeFleet(
             engine_factory(stages, serve_cfg, **engine_kw), journal_dir,
-            n_replicas=args.serve_replicas, route=args.serve_route,
+            n_replicas=args.serve_replicas,
+            prefill_replicas=args.serve_prefill_replicas,
+            route=args.serve_route,
             metrics=metrics, autoscale=autoscale,
             max_restarts=args.serve_max_restarts,
             default_deadline_s=(args.serve_deadline_ms / 1e3
@@ -1011,6 +1056,9 @@ def _run_serve(args, n_stages: int, key) -> None:
         print(f"| serve: fleet of {args.serve_replicas} replica(s), "
               f"route {args.serve_route} (journals "
               f"{journal_dir}/journal-r*.jsonl"
+              + (f", disaggregated {args.serve_prefill_replicas} prefill "
+                 f"+ {args.serve_replicas - args.serve_prefill_replicas} "
+                 f"decode" if args.serve_prefill_replicas else "")
               + (f", autoscale [{autoscale.min_replicas}, "
                  f"{autoscale.max_replicas}]" if autoscale else "")
               + (f", chaos {args.serve_chaos!r}" if args.serve_chaos
@@ -1110,6 +1158,21 @@ def _run_serve(args, n_stages: int, key) -> None:
               f"{s.get('fleet_retired', 0)} retired, "
               f"{s.get('restarts', 0)} in-place restart(s), "
               f"journals {s.get('journal_bytes', 0)} bytes")
+        if args.serve_prefill_replicas:
+            print(f"| serve: disaggregated — {engine.handoffs} "
+                  f"prefill->decode handoff(s), pools "
+                  + ", ".join(
+                      f"{p}[{b['replicas']} replica(s), queue "
+                      f"{b['queue_depth']}, {b['slots_active']} active]"
+                      for p, b in sorted((s.get("pools") or {}).items())))
+        if args.serve_host_blocks:
+            print(f"| serve: host tier {s.get('host_blocks', 0)} block(s) "
+                  f"resident ({s.get('host_bytes_resident', 0)} bytes), "
+                  f"{s.get('host_demotes', 0)} demote(s), "
+                  f"{s.get('host_promotes', 0)} promote(s), prefetch "
+                  f"{s.get('host_prefetch_hits', 0)} hit(s)/"
+                  f"{s.get('host_prefetch_misses', 0)} miss(es), "
+                  f"{s.get('host_transfer_bytes', 0)} bytes transferred")
     if supervised:
         print(f"| serve: supervisor {engine.state}, "
               f"{s.get('restarts', 0)} restart(s), "

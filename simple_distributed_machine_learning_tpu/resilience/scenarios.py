@@ -136,6 +136,12 @@ class Scenario:
     sim: SimConfig
     n_slots: int = 3
     block_size: int = 8
+    # pool capacity in blocks and per-slot length cap (None = the engine
+    # defaults): the cache-pressure dial — the offload-churn scenario
+    # shrinks both so LRU eviction (and host-tier demotion) actually
+    # happens regardless of the model's seq_len
+    n_blocks: int | None = None
+    max_len: int | None = None
     prefill_chunk: int | None = None
     scheduler: str = "priority"        # "fcfs" | "priority"
     chaos: str | None = None           # FaultPlan.parse spec, or None
@@ -164,6 +170,19 @@ class Scenario:
     route: str = "affinity"
     autoscale: "object | None" = None       # AutoscalePolicy
     min_migrations: int = 0
+    # disaggregated serving (ISSUE 17): prefill_replicas > 0 splits the
+    # fleet into prefill/decode pools (serve/fleet.py) and min_handoffs is
+    # its vacuous-pass gate (a disaggregated scenario that never handed
+    # off must FAIL); host_cache_blocks/prefetch_ticks enable the paged
+    # pool's host offload tier on every engine the run builds, and
+    # min_host_demotes is ITS vacuous-pass gate (an offload-churn
+    # scenario whose pressure never demoted a block must FAIL)
+    prefill_replicas: int = 0
+    min_handoffs: int = 0
+    host_cache_blocks: int = 0
+    prefetch_ticks: int = 1
+    min_host_demotes: int = 0
+    min_host_prefetch_hits: int = 0
 
     def __post_init__(self):
         if self.scheduler not in ("fcfs", "priority"):
@@ -193,10 +212,21 @@ class Scenario:
                 raise ValueError(f"route must be one of {POLICIES}, got "
                                  f"{self.route!r}")
         elif (self.min_migrations or self.autoscale is not None
-              or self.route != "affinity"):
+              or self.route != "affinity" or self.prefill_replicas
+              or self.min_handoffs):
             raise ValueError(
-                "route/autoscale/min_migrations are fleet knobs — set "
-                "replicas > 0")
+                "route/autoscale/min_migrations/prefill_replicas/"
+                "min_handoffs are fleet knobs — set replicas > 0")
+        if self.min_handoffs and not self.prefill_replicas:
+            raise ValueError(
+                "min_handoffs needs prefill_replicas > 0 (only a "
+                "disaggregated fleet hands off)")
+        if ((self.min_host_demotes or self.min_host_prefetch_hits)
+                and not self.host_cache_blocks):
+            raise ValueError(
+                "min_host_demotes/min_host_prefetch_hits need "
+                "host_cache_blocks > 0 (only the host offload tier "
+                "demotes and prefetches)")
 
 
 # SLO targets are VIRTUAL milliseconds (see module docstring): an engine
@@ -346,6 +376,78 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
                                   scale_out_queue_depth=4,
                                   scale_out_ticks=2,
                                   retire_idle_s=0.08)),
+    Scenario(
+        name="disagg-prefill-heavy",
+        description="bursty long-prompt arrivals whose decodes linger, "
+                    "over a 4-replica fleet split 2 prefill + 2 decode: "
+                    "new work boards the prefill pool only, every request "
+                    "hands off at end-of-prefill by the journal snap/"
+                    "adopt move, and lingering decodes clog the DECODE "
+                    "pool's slots instead of blocking fresh prefills "
+                    "(gate: all complete AND every request actually "
+                    "handed off; tests pin disaggregated TTFT p95 "
+                    "strictly below the symmetric 4-replica fleet's on "
+                    "this exact workload)",
+        sim=SimConfig(n_requests=16, rate=14.0, seed=0, arrival="bursty",
+                      burst_factor=5.0, burst_duty=0.25, period_s=1.0,
+                      classes=(dataclasses.replace(
+                          _INTERACTIVE, weight=1.0, prompt_lens=(12, 16),
+                          max_new_tokens=24, ttft_slo_ms=400.0,
+                          tpot_slo_ms=None),)),
+        n_slots=2, prefill_chunk=4, scheduler="fcfs",
+        replicas=4, prefill_replicas=2, min_handoffs=16),
+    Scenario(
+        name="offload-churn",
+        description="hot-prefix traffic interleaved with prefix-less "
+                    "background scans under block-pool pressure, host "
+                    "offload tier on: every scan burst evicts the idle "
+                    "system prompt out of the 12-block pool, the LRU "
+                    "eviction demotes it to host RAM instead of "
+                    "discarding it, and the next hot arrival's "
+                    "routing-time prefetch uploads it back ahead of "
+                    "admission (gates: all complete AND demotions AND "
+                    "prefetch hits actually happened; tests pin device "
+                    "prefix-hit blocks strictly above the HBM-only "
+                    "fleet's on this exact workload)",
+        sim=SimConfig(n_requests=24, rate=4.0, seed=0,
+                      shared_prefix_len=8, sampled_fraction=0.0,
+                      classes=(
+                          # hot tenant: every prompt opens with the shared
+                          # system prompt (2 blocks at block_size=4)
+                          dataclasses.replace(
+                              _INTERACTIVE, weight=1.0, prompt_lens=(4,),
+                              max_new_tokens=4, ttft_slo_ms=None,
+                              tpot_slo_ms=None),
+                          # background scans: NO shared prefix, long
+                          # prompts — their allocations evict the idle
+                          # prefix out of the 12-block pool between hot
+                          # arrivals, demoting it to the host tier
+                          TrafficClass(name="scan", weight=1.0,
+                                       prompt_lens=(16,),
+                                       max_new_tokens=8,
+                                       shared_prefix=False))),
+        n_slots=2, block_size=4, n_blocks=12, max_len=48, prefill_chunk=4,
+        scheduler="fcfs",
+        replicas=1, host_cache_blocks=12, prefetch_ticks=1,
+        min_host_demotes=1, min_host_prefetch_hits=1),
+    Scenario(
+        name="handoff-replica-loss",
+        description="disaggregated fleet (1 prefill + 2 decode) with a "
+                    "DECODE replica killed while handoffs are in flight: "
+                    "handed-off requests re-adopt onto the surviving "
+                    "decode replica from the dead one's journal alone, "
+                    "and the handoff journal event keeps the prefill "
+                    "source from double-serving them (gate: all complete "
+                    "AND >= 1 handoff AND >= 1 migration; per-stream "
+                    "bit-exactness through the race is pinned in "
+                    "tests/test_disagg.py)",
+        sim=SimConfig(n_requests=16, rate=12.0, seed=0,
+                      classes=(dataclasses.replace(_INTERACTIVE,
+                                                   weight=1.0),)),
+        n_slots=2, prefill_chunk=4, scheduler="fcfs",
+        replicas=3, prefill_replicas=1,
+        chaos="replica-kill@fleet.tick=6,rank=1",
+        min_handoffs=1, min_migrations=1),
 )}
 
 
@@ -353,7 +455,9 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                  outdir: str | None = None, scheduler: str | None = None,
                  virtual: bool = True, per_call_s: float = 0.001,
                  supervised: bool | None = None, trace=None,
-                 route: str | None = None) -> dict:
+                 route: str | None = None,
+                 prefill_replicas: int | None = None,
+                 host_cache_blocks: int | None = None) -> dict:
     """Run one scenario end to end; returns the report with the SLO block.
 
     ``stages``/``cfg``: a ``make_gpt_stages`` build (the engine's usual
@@ -378,6 +482,16 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     affinity hits, scale events, the replica-count trajectory).
     ``report["slo_ok"]`` then additionally requires at least
     ``min_migrations`` cross-replica migrations to have happened.
+
+    ``prefill_replicas``/``host_cache_blocks`` override the scenario's
+    disaggregation and host-offload-tier knobs the same way ``scheduler``
+    and ``route`` do — forcing ``prefill_replicas=0`` IS the symmetric
+    baseline the disaggregated TTFT gate compares against, and forcing
+    ``host_cache_blocks=0`` IS the HBM-only baseline the host-tier
+    prefix-hit gate compares against (tests pin both sides of each).
+    ``slo_ok`` additionally requires ``min_handoffs`` handoffs (only when
+    the run is actually disaggregated) and ``min_host_demotes`` demotions
+    (only when the host tier is actually on) to have happened.
 
     ``trace`` enables request-scoped tracing (``serve/tracing.py``):
     ``True`` builds a :class:`~..serve.tracing.ServeTrace` (written to
@@ -409,6 +523,10 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     sup_flag = scenario.supervised if supervised is None else supervised
     fleet_flag = scenario.replicas > 0
     route_policy = route or scenario.route
+    n_prefill = (scenario.prefill_replicas if prefill_replicas is None
+                 else prefill_replicas)
+    n_host = (scenario.host_cache_blocks if host_cache_blocks is None
+              else host_cache_blocks)
 
     plan = None
     if scenario.chaos:
@@ -430,8 +548,13 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                                suffix=f"-{scenario.name}" if outdir else "")
         engine_kw = dict(n_slots=scenario.n_slots,
                          block_size=scenario.block_size,
+                         n_blocks=scenario.n_blocks,
+                         max_len=scenario.max_len,
                          prefill_chunk=scenario.prefill_chunk,
                          scheduler=sched_cls, metrics=metrics, clock=clock)
+        if n_host:
+            engine_kw["host_cache_blocks"] = n_host
+            engine_kw["prefetch_ticks"] = scenario.prefetch_ticks
         if trace and not (sup_flag or fleet_flag):
             engine_kw["trace"] = trace
         if fleet_flag:
@@ -442,7 +565,8 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                 jdir = tmpdir.name
             target = ServeFleet(
                 engine_factory(stages, cfg, **engine_kw), jdir,
-                n_replicas=scenario.replicas, route=route_policy,
+                n_replicas=scenario.replicas,
+                prefill_replicas=n_prefill, route=route_policy,
                 metrics=metrics, clock=clock,
                 autoscale=scenario.autoscale,
                 max_restarts=scenario.max_restarts,
@@ -522,9 +646,36 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
             "retired": int(metrics.fleet_retired.value),
             "replica_log": list(target.replica_log),
         }
+        if n_prefill:
+            report["fleet"]["prefill_replicas"] = n_prefill
+            report["fleet"]["handoffs"] = target.handoffs
+            ok &= target.handoffs >= scenario.min_handoffs
         report["restarts"] = sum(
             r.supervisor.restarts for r in target.replicas)
         ok &= target.migrations >= scenario.min_migrations
+    if n_host:
+        # host-offload-tier outcomes, summed over every pool the run
+        # built (fleet replicas share one ServeMetrics, whose counters
+        # aggregate the per-pool deltas)
+        report["host_tier"] = {
+            "host_cache_blocks": n_host,
+            "demotes": int(metrics._host_counters[
+                "host_demotes_total"].value),
+            "promotes": int(metrics._host_counters[
+                "host_promotes_total"].value),
+            "prefetch_hits": int(metrics._host_counters[
+                "host_prefetch_hits_total"].value),
+            "prefetch_misses": int(metrics._host_counters[
+                "host_prefetch_misses_total"].value),
+            "host_evictions": int(metrics._host_counters[
+                "host_evictions_total"].value),
+            "transfer_bytes": int(metrics._host_counters[
+                "host_transfer_bytes_total"].value),
+        }
+        ok &= (report["host_tier"]["demotes"]
+               >= scenario.min_host_demotes)
+        ok &= (report["host_tier"]["prefetch_hits"]
+               >= scenario.min_host_prefetch_hits)
     if trace:
         report["trace_events"] = trace.n_events
     for tc in scenario.sim.classes:
@@ -561,6 +712,7 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
             **({"restarts": report["restarts"]} if sup_flag else {}),
             **({"fleet": {k: v for k, v in report["fleet"].items()
                           if k != "replica_log"}} if fleet_flag else {}),
+            **({"host_tier": report["host_tier"]} if n_host else {}),
             **({"faults_fired": plan.stats()["total_fired"]}
                if plan is not None else {}),
         })

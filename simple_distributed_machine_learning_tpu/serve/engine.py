@@ -111,7 +111,11 @@ class InferenceEngine:
     per-row f32 scales (``models/gpt.py::QuantKV``) — roughly 3.6x more
     resident requests per byte than f32 at pinned-tolerance logits, with
     dequantize fused into both attention paths; paged-only (dense layouts
-    reject it).
+    reject it). ``host_cache_blocks > 0`` enables the LRU host-RAM
+    offload tier (evicted prefix blocks demote to host; a router affinity
+    hit on a host-resident prefix starts an async upload landing after
+    ``prefetch_ticks`` ticks — ``serve/slots.py`` "Host offload tier");
+    paged-only as well.
 
     Tensor parallelism: build ``cfg`` with ``n_tensor_parallel = tp > 1``
     (the stages stay the UNSHARDED dense build) and pass a ``mesh`` whose
@@ -136,6 +140,7 @@ class InferenceEngine:
                  max_len: int | None = None, cache_dtype=None,
                  kv_layout: str = "paged", block_size: int = 16,
                  n_blocks: int | None = None, prefill_chunk: int | None = None,
+                 host_cache_blocks: int = 0, prefetch_ticks: int = 1,
                  attn_kernel: str = "dense",
                  metrics: ServeMetrics | None = None,
                  scheduler: FCFSScheduler | None = None,
@@ -176,6 +181,11 @@ class InferenceEngine:
             raise ValueError(
                 "prefill_chunk/n_blocks are paged-pool knobs; the dense "
                 "layout prefills whole prompts into fixed rows")
+        if kv_layout == "dense" and host_cache_blocks:
+            raise ValueError(
+                "host_cache_blocks is a paged-pool knob (the host offload "
+                "tier demotes evicted prefix BLOCKS); the dense layout has "
+                "no blocks to demote — use kv_layout='paged'")
         if (draft_stages is None) != (draft_cfg is None):
             raise ValueError(
                 "speculative decoding needs BOTH draft_stages and "
@@ -208,7 +218,9 @@ class InferenceEngine:
             self.pool = PagedKVPool(n_layers, n_slots, cfg.n_heads,
                                     self.max_len, head_dim, cache_dtype,
                                     block_size=block_size, n_blocks=n_blocks,
-                                    tp=self.tp)
+                                    tp=self.tp,
+                                    host_cache_blocks=host_cache_blocks,
+                                    prefetch_ticks=prefetch_ticks)
             self._chunk_prefill = make_paged_prefill_chunk(
                 stages, cfg, self.max_len, block_size, cache_dtype,
                 mesh=mesh)
@@ -463,6 +475,10 @@ class InferenceEngine:
             emitted += (self._spec_tick(self.pool.active_slots())
                         if self.speculative else self._decode_tick_dense())
         else:
+            # host-tier upload progress FIRST: blocks completing this tick
+            # register before admission probes the prefix registry, so a
+            # request blocked on its own prefetch boards this very tick
+            self.pool.advance_transfers()
             self._admit_paged()
             emitted = self._prefill_tick()
             decoding = self._decoding_slots()

@@ -39,6 +39,24 @@ Every migrated request's full token stream is bit-exact vs the
 uninterrupted single-replica run — across double replica loss and a loss
 landing during another replica's crash recovery (tests/test_fleet.py).
 
+**Disaggregated prefill/decode pools** (``prefill_replicas > 0``). The
+fleet splits into two independently-sized pools: the router admits new
+work to PREFILL replicas only, and the tick a request finishes prefill
+(seated, first token emitted) the fleet fires the SAME journal
+``snap``/``adopt`` move used for failure migration as a planned
+**handoff** onto a decode replica — ``ServeSupervisor.release`` drops it
+from the source (journaling a terminal ``handoff`` event, so a later
+loss of the source replica can never re-adopt/double-serve it) and
+``adopt(reason="handoff")`` re-admits it on the destination, which makes
+every handed-off token stream bit-exact vs the symmetric single-pool
+run (tests/test_disagg.py pins f32 and int8, greedy and sampled).
+Decode replicas are where the host offload tier pays off
+(``host_cache_blocks``): the router knows the prompt BEFORE admission,
+so a host-tier-resident prefix on a decode replica starts its async
+host→HBM upload AT ROUTING TIME (``pool.prefetch``) — the upload
+overlaps the prefill pool's work, and the handoff affinity-routes to
+the replica where the blocks land.
+
 **Autoscaling** (:class:`AutoscalePolicy`). Scale-out: when the fleet's
 total queue depth (or the paged pools' resident-block fraction — the
 ``serve_kv_bytes_resident`` signal) sits at/above the high watermark for
@@ -129,6 +147,9 @@ class _Replica:
     idx: int
     supervisor: ServeSupervisor
     journal_path: str
+    #: pool membership: "mixed" (symmetric fleet), or "prefill"/"decode"
+    #: when the fleet runs disaggregated (``prefill_replicas > 0``)
+    role: str = "mixed"
     alive: bool = True
     in_rotation: bool = True
     healthy_streak: int = 0
@@ -155,9 +176,15 @@ class ServeFleet:
     per-replica gauges are last-writer-wins by design. Supervisor knobs
     (``max_restarts``/``degrade_after``/``overload``/deadline defaults)
     apply to every replica alike.
+
+    ``prefill_replicas > 0`` disaggregates the fleet (module docstring):
+    the first ``prefill_replicas`` replicas form the prefill pool, the
+    rest the decode pool, and every request hands off at end-of-prefill.
+    Mutually exclusive with ``autoscale``.
     """
 
     def __init__(self, factory, journal_dir: str, *, n_replicas: int = 2,
+                 prefill_replicas: int = 0,
                  route: str = "affinity", metrics=None,
                  clock=time.monotonic, autoscale: AutoscalePolicy | None
                  = None, max_restarts: int = 3,
@@ -170,6 +197,16 @@ class ServeFleet:
                  postmortem_dir: str | None = None) -> None:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if prefill_replicas and not 0 < prefill_replicas < n_replicas:
+            raise ValueError(
+                f"prefill_replicas {prefill_replicas} must leave at least "
+                f"one decode replica: 0 < prefill_replicas < "
+                f"n_replicas={n_replicas} (0 disables disaggregation)")
+        if prefill_replicas and autoscale is not None:
+            raise ValueError(
+                "autoscale and prefill_replicas are mutually exclusive: "
+                "the autoscaler sizes ONE symmetric pool, a disaggregated "
+                "fleet is fixed-size per role")
         if health_recover_ticks < 1:
             raise ValueError(f"health_recover_ticks must be >= 1, got "
                              f"{health_recover_ticks}")
@@ -217,15 +254,25 @@ class ServeFleet:
         self._backlog_ticks = 0
         self.replica_losses = 0
         self.migrations = 0
+        #: disaggregation: first ``prefill_replicas`` spawns take the
+        #: "prefill" role, the rest "decode"; 0 keeps the fleet symmetric
+        self.prefill_replicas = int(prefill_replicas)
+        self.disaggregated = prefill_replicas > 0
+        #: planned prefill→decode migrations fired (``_handoff_step``)
+        self.handoffs = 0
         #: dynamic fleet events — (tick, t, event, replica, alive count) —
         #: the trajectory the autoscale/loss scenarios pin exactly
         self.replica_log: list[dict] = []
-        for _ in range(n_replicas):
-            self._spawn_replica(log=None)
+        for i in range(n_replicas):
+            role = "mixed"
+            if self.disaggregated:
+                role = "prefill" if i < prefill_replicas else "decode"
+            self._spawn_replica(log=None, role=role)
 
     # -- replica lifecycle ---------------------------------------------------
 
-    def _spawn_replica(self, log: str | None) -> _Replica:
+    def _spawn_replica(self, log: str | None,
+                       role: str = "mixed") -> _Replica:
         idx = self._next_idx
         self._next_idx += 1
         path = os.path.join(self.journal_dir,
@@ -234,7 +281,12 @@ class ServeFleet:
             self.factory, RequestJournal(path, sync=self.journal_sync),
             metrics=self.metrics, clock=self._clock, trace=self.trace,
             postmortem_tag=f"-r{idx}", **self._sup_kw)
-        rep = _Replica(idx=idx, supervisor=sup, journal_path=path)
+        if role != "mixed":
+            # stamp the pool role onto every flight-recorder row the
+            # supervisor writes (serve/flight.py forensics join on it)
+            sup.pool_role = role
+        rep = _Replica(idx=idx, supervisor=sup, journal_path=path,
+                       role=role)
         self.replicas.append(rep)
         if log is not None:
             self._log_event(log, rep)
@@ -252,6 +304,21 @@ class ServeFleet:
 
     def _rotation(self) -> list[_Replica]:
         return [r for r in self.replicas if r.alive and r.in_rotation]
+
+    def _role_alive(self, role: str) -> list[_Replica]:
+        return [r for r in self.replicas if r.alive and r.role == role]
+
+    def _role_rotation(self, role: str) -> list[_Replica]:
+        return [r for r in self.replicas
+                if r.alive and r.in_rotation and r.role == role]
+
+    def _role_candidates(self, role: str) -> list[_Replica]:
+        """Routing candidates for one pool, degrading but never refusing:
+        in-rotation same-role, alive same-role, then ANY in-rotation /
+        alive replica — the fleet-wide never-refuse rule applied per
+        pool."""
+        return (self._role_rotation(role) or self._role_alive(role)
+                or self._rotation() or self._alive())
 
     @property
     def n_alive(self) -> int:
@@ -289,10 +356,22 @@ class ServeFleet:
         from simple_distributed_machine_learning_tpu.resilience.supervisor import (  # noqa: E501
             RestartBudgetExceeded,
         )
-        candidates = self._rotation() or self._alive()
+        if self.disaggregated:
+            # new work boards the PREFILL pool; the decode pool only ever
+            # receives requests via handoff (or loss migration)
+            candidates = self._role_candidates("prefill")
+        else:
+            candidates = self._rotation() or self._alive()
         rep, hit = self.router.route(prompt, candidates)
         if hit and self.metrics is not None:
             self.metrics.on_affinity_hit()
+        # the router knows the prefix BEFORE admission: if a host-tier
+        # copy of it beats what any target pool holds in HBM, start the
+        # async upload NOW so it overlaps queueing + prefill instead of
+        # serializing in front of the decode
+        self._prefetch_host(
+            prompt,
+            self._role_alive("decode") if self.disaggregated else [rep])
         rid = self._next_rid
         rep.supervisor.engine._next_rid = rid
         self._user_cb[rid] = on_token
@@ -348,13 +427,82 @@ class ServeFleet:
                 self._lose_replica(rep, f"RestartBudgetExceeded: {e}")
                 continue
             self._update_health(rep)
+        if self.disaggregated:
+            self._handoff_step()
         if self.autoscale is not None:
             self._autoscale_step()
         if self.metrics is not None:
             self.metrics.set_fleet_replicas(self.n_in_rotation)
             self.metrics.set_journal_bytes(
                 sum(r.supervisor.journal.bytes for r in self._alive()))
+            if self.disaggregated:
+                for role in ("prefill", "decode"):
+                    reps = self._role_alive(role)
+                    self.metrics.set_pool_stats(
+                        role, replicas=len(reps),
+                        queue_depth=sum(
+                            r.supervisor.scheduler.queue_depth
+                            for r in reps),
+                        slots_active=sum(
+                            r.supervisor.pool.n_active for r in reps))
         return emitted
+
+    # -- disaggregation: routing-time prefetch + end-of-prefill handoff ------
+
+    def _prefetch_host(self, prompt, candidates: list) -> None:
+        """Start the async host→HBM upload of the longest host-resident
+        prefix among ``candidates`` — only where the host copy strictly
+        beats what that replica's pool already holds in HBM (uploading a
+        prefix the registry already serves would waste the free blocks).
+        Pools without a host tier answer 0 everywhere, so symmetric
+        HBM-only fleets take this path as a no-op."""
+        best, best_len = None, 0
+        for r in candidates:
+            pool = r.supervisor.pool
+            n = pool.host_prefix_len(prompt)
+            if n > pool.shared_prefix_len(prompt) and n > best_len:
+                best, best_len = r, n
+        if best is not None:
+            best.supervisor.pool.prefetch(prompt)
+
+    def _handoff_step(self) -> None:
+        """The planned prefill→decode migration: every request on a
+        prefill replica that FINISHED its prefill this tick (seated,
+        first token emitted, still decoding) moves to the decode pool by
+        the same journal ``snap``/``adopt`` discipline a replica loss
+        uses — ``release`` journals a terminal ``handoff`` event on the
+        source (no double-serve if the source dies later) and
+        ``adopt(reason="handoff")`` snapshots it into the destination's
+        journal before re-admission, so the continued stream is bit-exact
+        vs never having moved. Routed per request through the SAME router
+        (affinity first): a prefix the routing-time prefetch landed in
+        the destination's HBM makes the handoff an affinity hit."""
+        decode = self._role_candidates("decode")
+        for src in self._role_alive("prefill"):
+            sup = src.supervisor
+            ready = sorted(
+                rid for rid, h in sup.requests.items()
+                if h.state == ACTIVE and h.prefill_pos is None
+                and h.tokens)
+            for rid in ready:
+                cand = [r for r in decode if r is not src] or decode
+                h = sup.requests[rid]
+                dst, hit = self.router.route(h.prompt, cand)
+                if dst is src:
+                    # degenerate fallback (every decode replica dead and
+                    # the source is the only survivor): nothing to move to
+                    continue
+                if hit and self.metrics is not None:
+                    self.metrics.on_affinity_hit()
+                if self.trace is not None:
+                    self.trace.on_migrate(h, self._now, src.idx, dst.idx)
+                h = sup.release(rid, dst=dst.idx)
+                dst.supervisor.adopt(h, on_token=self._user_cb.get(rid),
+                                     reason="handoff")
+                self._home[rid] = dst.idx
+                self.handoffs += 1
+                if self.metrics is not None:
+                    self.metrics.on_handoff()
 
     def drain(self, max_ticks: int | None = None) -> list[Request]:
         from simple_distributed_machine_learning_tpu.serve.engine import (
@@ -453,10 +601,22 @@ class ServeFleet:
         if not targets:
             # the last replica died: the fleet immediately replaces it —
             # in-flight work must never strand waiting for an autoscaler
-            targets = [self._spawn_replica(log="replace")]
+            targets = [self._spawn_replica(log="replace", role=rep.role)]
         adopted: dict[_Replica, int] = {}
         for h in inflight:
-            cand = [r for r in targets if r.in_rotation] or targets
+            if self.disaggregated:
+                # keep the pools honest across a loss: a request that has
+                # emitted tokens already finished prefill (re-adopt into
+                # the DECODE pool, even off a dying prefill replica mid-
+                # handoff); one without tokens still owes its prefill
+                role = "decode" if h.tokens else "prefill"
+                cand = ([r for r in targets
+                         if r.in_rotation and r.role == role]
+                        or [r for r in targets if r.role == role]
+                        or [r for r in targets if r.in_rotation]
+                        or targets)
+            else:
+                cand = [r for r in targets if r.in_rotation] or targets
             dst, hit = self.router.route(h.prompt, cand)
             if hit and self.metrics is not None:
                 self.metrics.on_affinity_hit()

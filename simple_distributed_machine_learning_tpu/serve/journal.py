@@ -23,7 +23,8 @@ line is written per token)::
     {"ev":"restart","n":1,"degraded":false,"cause":"EngineCrash"}
     {"ev":"snap","rid":3,"prompt":[...],"max_new":8,...,"state":"queued",
      "reason":null,"toks":[17,4],"kd":[123,456],"dkd":null,"ftt":13.1,
-     "dt":null}
+     "dt":null,"why":"handoff"}
+    {"ev":"handoff","rid":3,"dst":2,"tick":7}
 
 A ``snap`` record is one request's ENTIRE recovered state in a single
 line — everything the per-event records would fold to. Two writers emit
@@ -35,6 +36,23 @@ request's snapshot first, so ITS journal alone recovers the adoptee
 through any later crash). Ordinary ``tok``/``done``/``shed`` records keep
 folding on top of a ``snap``, so a rotated journal appends exactly like
 an unrotated one.
+
+``why`` is the snap's MIGRATION CAUSE — ``"failure"`` (a replica loss
+moved the request) vs ``"handoff"`` (the disaggregated fleet's planned
+end-of-prefill move; ``serve/fleet.py``) — so recovery tooling and the
+report CLI can tell unplanned migrations from routine handoffs. The JSON
+key is ``why`` (not ``reason``: that key already carries
+``finish_reason`` in snap records, a grammar fact older journals bake
+in); the Python API surface calls it ``reason``
+(:meth:`RequestJournal.log_snapshot`, ``ServeSupervisor.adopt``).
+Like ``tick``, it is absent when the writer supplies none — journals
+written before the field existed recover unchanged (regression-pinned).
+
+A ``handoff`` record marks a rid as MOVED OUT of this journal: the
+source replica writes it when the fleet hands the request to a decode
+replica (whose own journal now carries the authoritative ``snap``), and
+:func:`recover_state` DROPS the rid — so a later loss of the source
+replica can never re-adopt, and double-serve, a request that left.
 
 Corruption tolerance mirrors ``CheckpointStore.latest_valid``: a crash can
 tear at most the tail, so :func:`read_journal` keeps the longest prefix of
@@ -164,7 +182,15 @@ def recover_state(events: list[dict]) -> dict[int, Request]:
                 r.draft_key_data = np.asarray(ev["dkd"], np.uint32)
             r.first_token_time = ev["ftt"]
             r.done_time = ev["dt"]
+            # migration cause: absent in pre-disaggregation journals (the
+            # pinned tolerance), and distinct from the "reason" key above
+            # (finish_reason — see module docstring)
+            r.snap_reason = ev.get("why")
             reqs[r.rid] = r
+        elif kind == "handoff":
+            # the request moved to another replica's journal: drop it here
+            # so a source-replica loss can never re-adopt (double-serve) it
+            reqs.pop(int(ev["rid"]), None)
         # "restart" records are observability only
     for r in reqs.values():
         if r.state == QUEUED and r.tokens:
@@ -277,11 +303,15 @@ class RequestJournal:
                      "degraded": bool(degraded), "cause": cause,
                      **self._tick_field(tick)})
 
-    def log_snapshot(self, request: Request, tick=None) -> None:
+    def log_snapshot(self, request: Request, tick=None,
+                     reason: str | None = None) -> None:
         """One request's ENTIRE state as a single ``snap`` record (module
         docstring grammar) — what :meth:`rotate` compacts to and what
         cross-replica migration writes into the adopting replica's
-        journal so it alone can recover the adoptee."""
+        journal so it alone can recover the adoptee. ``reason`` is the
+        migration cause (``"failure"``/``"handoff"``), journaled under
+        the ``why`` key and absent when None — see the module docstring
+        for why it cannot ride the ``reason`` key."""
         kd, dkd = request.key_data, request.draft_key_data
         self.append({
             "ev": "snap", "rid": request.rid,
@@ -299,7 +329,15 @@ class RequestJournal:
             "dkd": (None if dkd is None
                     else [int(x) for x in np.asarray(dkd)]),
             "ftt": request.first_token_time, "dt": request.done_time,
+            **({} if reason is None else {"why": reason}),
             **self._tick_field(tick)})
+
+    def log_handoff(self, *, rid: int, dst=None, tick=None) -> None:
+        """The rid moved to replica ``dst``'s journal (a prefill->decode
+        handoff): terminal for THIS journal — recovery drops the rid."""
+        self.append({"ev": "handoff", "rid": int(rid),
+                     "dst": None if dst is None else int(dst),
+                     **self._tick_field(tick)})
 
     def rotate(self, tick=None) -> int:
         """Compact the journal in place: fold everything durable into
@@ -323,7 +361,11 @@ class RequestJournal:
         writer._f = open(tmp, "wb")
         try:
             for rid in sorted(snaps):
-                writer.log_snapshot(snaps[rid], tick=tick)
+                # a recovered migration cause survives compaction (None for
+                # never-migrated rids and pre-field journals: key absent,
+                # so rotation stays byte-identical for them)
+                writer.log_snapshot(snaps[rid], tick=tick,
+                                    reason=snaps[rid].snap_reason)
         finally:
             writer.close()
         self._f.close()

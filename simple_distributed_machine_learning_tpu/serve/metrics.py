@@ -91,6 +91,40 @@ Fleet instruments (fed by the multi-replica fleet, ``serve/fleet.py``):
   (counters) — autoscaler actions: replicas added on sustained backlog,
   replicas drained-then-retired on sustained idleness.
 
+Disaggregated-pool + host-offload-tier instruments (ISSUE 17 — fed by
+the disaggregated fleet, ``serve/fleet.py``, and the paged pool's host
+tier, ``serve/slots.py``):
+
+- ``serve_fleet_handoffs_total`` (counter) — planned prefill→decode
+  migrations: requests moved at end-of-prefill by the same journal
+  snap/adopt move failure migration uses, each handed-off token stream
+  bit-exact vs the symmetric single-pool run;
+- ``serve_pool_replicas{pool=prefill|decode}`` (gauge) — alive replicas
+  per role pool: the independently-sized halves of a disaggregated
+  fleet;
+- ``serve_pool_queue_depth{pool=...}`` / ``serve_pool_slots_active{pool=...}``
+  (gauges) — per-pool backlog and occupancy: the imbalance signal the
+  disaggregated scenarios pin (prefill-heavy vs decode-heavy mixes);
+- ``serve_host_blocks`` / ``serve_host_bytes_resident`` (gauges) —
+  host-RAM offload tier occupancy: blocks demoted from HBM that live on
+  in host memory, and the bytes they pin there (the analyzer's
+  ``predict_host_kv_bytes`` reconciles the byte gauge exactly);
+- ``serve_host_inflight_blocks`` (gauge) — blocks mid async host→HBM
+  prefetch upload: reserved on device, keys not yet registered;
+- ``serve_host_demotes_total`` / ``serve_host_promotes_total`` /
+  ``serve_host_evictions_total`` (counters) — tier traffic: HBM
+  evictions demoted to host instead of dying, completed uploads that
+  re-registered their prefix keys in HBM, and host-side LRU drops at
+  ``host_cache_blocks`` capacity;
+- ``serve_host_prefetch_hits_total`` / ``serve_host_prefetch_misses_total``
+  (counters) — routing-time prefetch outcomes: a hit started (or joined)
+  the async upload of a host-resident prefix, a miss found nothing the
+  HBM registry didn't already cover or no free blocks to upload into;
+- ``serve_host_transfer_bytes_total`` (counter) — bytes moved across the
+  HBM↔host boundary in either direction (demotes down, promotes up) —
+  the transfer-bandwidth bill ``predict_transfer_bytes`` reconciles with
+  the same drift-must-be-zero discipline as ``serve_kv_drift_bytes``.
+
 Model-drift instruments (ISSUE 12 — the PR-8 static model checked as a
 runtime invariant, fed every tick from ``engine.kv_drift``):
 
@@ -126,6 +160,18 @@ _POOL_COUNTERS = {
     "prefix_hit_blocks_total": "serve_prefix_hit_blocks_total",
     "cow_copies_total": "serve_cow_copies_total",
     "evictions_total": "serve_block_evictions_total",
+}
+
+# host-offload-tier counter keys -> instrument names (same lifetime-total
+# to per-tick-delta conversion; present in ``stats()`` only when the pool
+# runs with ``host_cache_blocks > 0``)
+_HOST_COUNTERS = {
+    "host_demotes_total": "serve_host_demotes_total",
+    "host_promotes_total": "serve_host_promotes_total",
+    "host_evictions_total": "serve_host_evictions_total",
+    "host_prefetch_hits_total": "serve_host_prefetch_hits_total",
+    "host_prefetch_misses_total": "serve_host_prefetch_misses_total",
+    "host_transfer_bytes_total": "serve_host_transfer_bytes_total",
 }
 
 
@@ -196,7 +242,23 @@ class ServeMetrics:
             "serve_route_affinity_hits_total")
         self.fleet_scale_outs = r.counter("serve_fleet_scale_outs_total")
         self.fleet_retired = r.counter("serve_fleet_retired_total")
+        self.fleet_handoffs = r.counter("serve_fleet_handoffs_total")
         self._fleet_seen = False
+        # disaggregated per-pool gauges (labeled by role; fed by the fleet
+        # once per tick when it runs with prefill_replicas > 0)
+        self._pool_gauges: dict[tuple, object] = {}
+        self._pool_names: set[str] = set()
+        self._pools_seen = False
+        # host offload tier (paged pools with host_cache_blocks > 0;
+        # gauges set and counters delta-fed from block_stats exactly like
+        # the _POOL_COUNTERS discipline)
+        self.host_blocks = r.gauge("serve_host_blocks")
+        self.host_bytes_resident = r.gauge("serve_host_bytes_resident")
+        self.host_inflight = r.gauge("serve_host_inflight_blocks")
+        self._host_counters = {k: r.counter(v)
+                               for k, v in _HOST_COUNTERS.items()}
+        self._host_counter_seen = dict.fromkeys(_HOST_COUNTERS, 0)
+        self._host_seen = False
         self._classes: set[str] = set()
         if outdir:
             os.makedirs(outdir, exist_ok=True)
@@ -296,6 +358,32 @@ class ServeMetrics:
         self._fleet_seen = True
         self.fleet_retired.inc()
 
+    def on_handoff(self, n: int = 1) -> None:
+        """``n`` planned prefill→decode handoffs fired this fleet tick."""
+        self._fleet_seen = True
+        if n:
+            self.fleet_handoffs.inc(n)
+
+    def _pool_gauge(self, name: str, pool: str):
+        key = (name, pool)
+        g = self._pool_gauges.get(key)
+        if g is None:
+            g = self._pool_gauges[key] = self.registry.gauge(
+                name, labels={"pool": pool})
+        return g
+
+    def set_pool_stats(self, pool: str, *, replicas: int,
+                       queue_depth: int, slots_active: int) -> None:
+        """One role pool's end-of-tick shape (disaggregated fleets only):
+        alive replicas, summed queue depth, summed active slots."""
+        self._pools_seen = True
+        self._pool_names.add(pool)
+        self._pool_gauge("serve_pool_replicas", pool).set(int(replicas))
+        self._pool_gauge("serve_pool_queue_depth",
+                         pool).set(int(queue_depth))
+        self._pool_gauge("serve_pool_slots_active",
+                         pool).set(int(slots_active))
+
     def _on_any_token(self) -> None:
         self.tokens.inc()
         self._t_last_token = self._clock()
@@ -370,6 +458,19 @@ class ServeMetrics:
                 if delta > 0:
                     counter.inc(delta)
                     self._pool_counter_seen[key] = block_stats[key]
+            if "host_blocks" in block_stats:
+                self._host_seen = True
+                self.host_blocks.set(block_stats["host_blocks"])
+                self.host_bytes_resident.set(
+                    block_stats["host_bytes_resident"])
+                self.host_inflight.set(
+                    block_stats["host_inflight_blocks"])
+                for key, counter in self._host_counters.items():
+                    delta = (block_stats[key]
+                             - self._host_counter_seen[key])
+                    if delta > 0:
+                        counter.inc(delta)
+                        self._host_counter_seen[key] = block_stats[key]
 
     # -- aggregation -------------------------------------------------------
 
@@ -468,6 +569,35 @@ class ServeMetrics:
                 "route_affinity_hits": int(self.route_affinity_hits.value),
                 "fleet_scale_outs": int(self.fleet_scale_outs.value),
                 "fleet_retired": int(self.fleet_retired.value),
+                "fleet_handoffs": int(self.fleet_handoffs.value),
+            })
+        if self._pools_seen:
+            out["pools"] = {
+                pool: {
+                    "replicas": int(self._pool_gauge(
+                        "serve_pool_replicas", pool).value),
+                    "queue_depth": int(self._pool_gauge(
+                        "serve_pool_queue_depth", pool).value),
+                    "slots_active": int(self._pool_gauge(
+                        "serve_pool_slots_active", pool).value),
+                } for pool in sorted(self._pool_names)}
+        if self._host_seen:
+            out.update({
+                "host_blocks": int(self.host_blocks.value),
+                "host_bytes_resident": int(self.host_bytes_resident.value),
+                "host_inflight_blocks": int(self.host_inflight.value),
+                "host_demotes": int(self._host_counters[
+                    "host_demotes_total"].value),
+                "host_promotes": int(self._host_counters[
+                    "host_promotes_total"].value),
+                "host_evictions": int(self._host_counters[
+                    "host_evictions_total"].value),
+                "host_prefetch_hits": int(self._host_counters[
+                    "host_prefetch_hits_total"].value),
+                "host_prefetch_misses": int(self._host_counters[
+                    "host_prefetch_misses_total"].value),
+                "host_transfer_bytes": int(self._host_counters[
+                    "host_transfer_bytes_total"].value),
             })
         if self._drift_seen:
             out["kv_bytes_predicted"] = int(self.kv_bytes_predicted.value)

@@ -78,6 +78,10 @@ class Request:
     # "eos" | "length", or the SHED reasons "deadline" | "backpressure"
     # | "class"
     finish_reason: str | None = None
+    # migration cause of the last journal `snap` written for this request
+    # ("failure" | "handoff"; the record's `why` key — serve/journal.py),
+    # None for never-migrated requests and pre-field journals
+    snap_reason: str | None = None
     # preemption accounting: a preempted request goes back to QUEUED with
     # its emitted tokens intact; re-admission recomputes its K/V from
     # `resume_seq` WITHOUT touching the key stream, so the continued decode

@@ -14,7 +14,10 @@ already maintains:
   prefill once on one replica, and every later request with the same
   prefix lands where the blocks already live instead of recomputing them
   on a cold replica (the hot-prefix-skew scenario pins affinity strictly
-  above round-robin on the prefix-hit counters). Ties — including the
+  above round-robin on the prefix-hit counters). A prefix resident in a
+  replica's HOST offload tier (``pool.host_prefix_len``) counts too —
+  those blocks are one async prefetch upload away, which the fleet
+  starts at routing time (``serve/fleet.py``). Ties — including the
   no-registered-prefix cold start — fall back to least-loaded.
 - **Least-loaded fallback** (policy ``"least-loaded"``): order replicas by
   ``(queue_depth, occupancy, idx)`` — the same quantities the PR-4
@@ -83,7 +86,14 @@ class FleetRouter:
             prompt = np.asarray(prompt, np.int32)
             best, best_len = None, 0
             for rep in candidates:
-                n = rep.supervisor.pool.shared_prefix_len(prompt)
+                pool = rep.supervisor.pool
+                # HBM-registered prefix OR host-tier-resident prefix: a
+                # host hit is still an affinity hit — the blocks are one
+                # async upload away (pool.prefetch), which beats
+                # recomputing the prefix on a cold replica. Pools without
+                # a host tier answer 0, so the signal is unchanged there.
+                n = max(pool.shared_prefix_len(prompt),
+                        pool.host_prefix_len(prompt))
                 if n > best_len:
                     best, best_len = rep, n
             if best is not None:

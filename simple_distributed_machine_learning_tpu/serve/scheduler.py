@@ -164,6 +164,12 @@ class PriorityScheduler(FCFSScheduler):
     def _make_room(self, request: Request) -> bool:
         if self._engine is None:
             return False
+        if self.pool.prefetch_blocked(request):
+            # an in-flight host->HBM upload covers this request's prefix:
+            # the ONE can_admit failure eviction can never fix — it boards
+            # when the upload lands, so preempting would destroy work for
+            # nothing (serve/slots.py host offload tier)
+            return False
         victims = self._victims_below(request.priority)
         if not victims:
             return False

@@ -61,6 +61,11 @@ class TrafficClass:
     prompt_lens: tuple | None = None
     ttft_deadline_ms: float | None = None
     deadline_ms: float | None = None
+    # opt out of the SimConfig-wide shared system prompt: background
+    # classes whose prompts deliberately do NOT carry the hot prefix (the
+    # offload-churn scenario uses one to push the idle prefix out of HBM
+    # so the host tier's demote/prefetch cycle actually exercises)
+    shared_prefix: bool = True
 
     def __post_init__(self):
         if not self.name:
@@ -227,8 +232,9 @@ def build_workload(sim: SimConfig, vocab: int) -> tuple[np.ndarray, list]:
         lens = (cls.prompt_lens if cls is not None and cls.prompt_lens
                 else sim.prompt_lens)
         t0 = int(lens[i % len(lens)])
-        prompt = np.concatenate(
-            [prefix, rng.integers(0, vocab, t0).astype(np.int32)])
+        body = rng.integers(0, vocab, t0).astype(np.int32)
+        prompt = (np.concatenate([prefix, body])
+                  if cls is None or cls.shared_prefix else body)
         sampled = rng.random() < sim.sampled_fraction
         spec = dict(
             prompt=prompt,

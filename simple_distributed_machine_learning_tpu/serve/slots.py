@@ -40,6 +40,22 @@ REUSED by a live request, so a garbage write there would corrupt a
 neighbor. The engine therefore routes every non-decoding slot's tick write
 to the trash block (``PagedKVPool.TRASH``, position 0), which no block
 table ever references.
+
+Host offload tier (paged, ``host_cache_blocks > 0``): LRU eviction of a
+cached prefix block demotes its rows to host RAM instead of discarding
+them, growing the effective prefix cache past HBM. The router probes the
+host registry too (:meth:`PagedKVPool.host_prefix_len`), and an affinity
+hit on a host-resident prefix starts an **async upload**
+(:meth:`PagedKVPool.prefetch`) that lands after ``prefetch_ticks`` engine
+ticks (:meth:`PagedKVPool.advance_transfers`). Safety model: the uploaded
+keys are registered — and therefore visible to admission's prefix probe —
+only at COMPLETION, and ``can_admit`` additionally blocks a request whose
+prefix an in-flight upload covers, so a request can never board against
+half-uploaded blocks (it waits one or two ticks and then shares the real
+ones). Uploads draw from the FREE list only, never by evicting live or
+cached blocks, and never below the pool's outstanding reservations — a
+prefetch can be refused (a miss), but it can never thrash the working set
+or strand an admitted sequence's allocation.
 """
 
 from __future__ import annotations
@@ -199,6 +215,20 @@ class _SlotPoolBase:
         (``serve/router.py``). The dense layout shares nothing: 0."""
         return 0
 
+    def host_prefix_len(self, prompt) -> int:
+        """Prompt positions resident in this pool's HOST offload tier — the
+        router's second affinity signal (an affinity hit here starts the
+        async prefetch upload). Pools without a host tier: 0."""
+        return 0
+
+    def prefetch_blocked(self, request) -> bool:
+        """True while an in-flight host->HBM upload covers a prefix of
+        ``request``'s bind sequence — the one ``can_admit`` failure that
+        preemption can NEVER fix (the PriorityScheduler must not evict
+        work for it; the request boards when the upload lands). Pools
+        without a host tier: never."""
+        return False
+
     # -- preemption feasibility (PriorityScheduler's precheck) --------------
 
     def admit_shortfall(self, request) -> int:
@@ -296,9 +326,22 @@ class PagedKVPool(_SlotPoolBase):
     def __init__(self, n_layers: int, n_slots: int, n_heads: int,
                  max_len: int, head_dim: int, cache_dtype=None,
                  block_size: int = 16, n_blocks: int | None = None,
-                 tp: int = 1) -> None:
+                 tp: int = 1, host_cache_blocks: int = 0,
+                 prefetch_ticks: int = 1) -> None:
         super().__init__(n_slots, max_len)
         self.tp = _check_tp(n_heads, tp)
+        if host_cache_blocks < 0:
+            raise ValueError(
+                f"host_cache_blocks must be >= 0, got {host_cache_blocks}")
+        if host_cache_blocks and self.tp > 1:
+            raise ValueError(
+                "host_cache_blocks with tp > 1 is not supported: demotion "
+                "copies device rows to host per pool, and a sharded pool "
+                "would demote per-shard fragments the prefetch upload "
+                "cannot re-place")
+        if prefetch_ticks < 1:
+            raise ValueError(
+                f"prefetch_ticks must be >= 1, got {prefetch_ticks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.block_size = block_size
@@ -371,6 +414,24 @@ class PagedKVPool(_SlotPoolBase):
         self.prefix_hit_blocks_total = 0
         self.cow_copies_total = 0
         self.evictions_total = 0
+        # -- host offload tier (module docstring, "Host offload tier") ----
+        self.host_cache_blocks = host_cache_blocks
+        self.prefetch_ticks = prefetch_ticks
+        # host_id -> {"keys": {key: fill}, "kc": ..., "vc": ...} where
+        # kc/vc are host (numpy) pytrees of one block's rows, LRU order
+        self._host: collections.OrderedDict[int, dict] = (
+            collections.OrderedDict())
+        self._host_prefix: dict[bytes, tuple[int, int]] = {}
+        self._next_host_id = 0
+        # in-flight uploads: {"entries": [(key, fill, host_id)],
+        # "blocks": [phys], "ticks_left": int}
+        self._inflight: list[dict] = []
+        self.host_demotes_total = 0
+        self.host_promotes_total = 0
+        self.host_evictions_total = 0
+        self.host_prefetch_hits_total = 0
+        self.host_prefetch_misses_total = 0
+        self.host_transfer_bytes_total = 0
 
     # -- capacity ----------------------------------------------------------
 
@@ -416,8 +477,14 @@ class PagedKVPool(_SlotPoolBase):
         (``_ref_block`` pulls them from the LRU), which shrinks
         ``blocks_available`` without consuming reservation — counting them
         as both "shared, free of charge" and "reclaimable headroom" would
-        approve a request ``begin_seq`` cannot actually fund."""
-        return bool(self._free) and self.admit_shortfall(request) == 0
+        approve a request ``begin_seq`` cannot actually fund.
+
+        A request whose prefix an in-flight host->HBM upload covers is
+        additionally held back (:meth:`prefetch_blocked`): boarding now
+        would recompute — or worse, share half-uploaded rows — instead of
+        waiting the tick or two for the registered blocks to land."""
+        return (bool(self._free) and self.admit_shortfall(request) == 0
+                and not self.prefetch_blocked(request))
 
     def admit_shortfall(self, request) -> int:
         """Blocks ``request`` is short of admission (0 = the block budget
@@ -559,6 +626,10 @@ class PagedKVPool(_SlotPoolBase):
             block = self._free_blocks.pop()
         elif self._lru:
             block, _ = self._lru.popitem(last=False)   # evict LRU cached
+            if self.host_cache_blocks:
+                # demote-to-host BEFORE the keys drop: the evicted prefix
+                # survives in the offload tier instead of dying
+                self._demote(block)
             for key in list(self._cached.get(block, ())):
                 del self._prefix[key]
             self._cached.pop(block, None)
@@ -685,6 +756,203 @@ class PagedKVPool(_SlotPoolBase):
                     del self._lru[block]
                     self._free_blocks.append(block)
 
+    # -- host offload tier -------------------------------------------------
+
+    def _block_to_host(self, cache, block: int):
+        """One physical block's rows as a host (numpy) pytree — a QuantKV
+        cache's narrow data and f32 scale planes travel together."""
+        import jax
+        return jax.tree.map(lambda a: np.asarray(a[:, block]), cache)
+
+    def _demote(self, block: int) -> None:
+        """Copy an evicted cached block's rows (and its registered prefix
+        keys) into the host tier before the device registry forgets them.
+        A key already host-resident is re-pointed at the fresh copy (the
+        content is identical — the key IS the token prefix, which fully
+        determines the block's K/V); capacity overflow drops the LRU host
+        entry (``host_evictions_total`` — the tier's true end of life)."""
+        keys = {k: self._prefix[k][1] for k in self._cached.get(block, ())}
+        if not keys:                # pragma: no cover - LRU blocks are cached
+            return
+        hid = self._next_host_id
+        self._next_host_id += 1
+        for key in keys:
+            old = self._host_prefix.get(key)
+            if old is not None:
+                self._drop_host_key(key, old[0])
+        self._host[hid] = {"keys": keys,
+                           "kc": self._block_to_host(self.kc, block),
+                           "vc": self._block_to_host(self.vc, block)}
+        for key, fill in keys.items():
+            self._host_prefix[key] = (hid, fill)
+        self.host_demotes_total += 1
+        self.host_transfer_bytes_total += self.bytes_per_block
+        while len(self._host) > self.host_cache_blocks:
+            ev_id, ev = self._host.popitem(last=False)
+            for key in ev["keys"]:
+                if self._host_prefix.get(key, (None, 0))[0] == ev_id:
+                    del self._host_prefix[key]
+            self.host_evictions_total += 1
+
+    def _drop_host_key(self, key: bytes, hid: int) -> None:
+        entry = self._host.get(hid)
+        if entry is None:           # pragma: no cover - guard
+            return
+        entry["keys"].pop(key, None)
+        if not entry["keys"]:
+            del self._host[hid]
+
+    def host_prefix_len(self, prompt) -> int:
+        """The host-tier affinity signal: longest host-resident prefix of
+        ``prompt`` (in positions). A pure probe, like
+        :meth:`shared_prefix_len` — the router may ask freely."""
+        return self._probe_host(np.asarray(prompt, np.int32))[0]
+
+    def _probe_host(self, prompt: np.ndarray
+                    ) -> tuple[int, list[tuple[bytes, int, int]]]:
+        """:meth:`_probe_prefix`'s walk against the HOST registry. Returns
+        ``(shared_len, [(key, fill, host_id), ...])`` without mutating."""
+        prompt = np.asarray(prompt, np.int32)
+        cap = int(prompt.shape[0]) - 1
+        bs = self.block_size
+        chain: list[tuple[bytes, int, int]] = []
+        shared = 0
+        j = 0
+        while True:
+            hit = None
+            for length in range(min(cap, (j + 1) * bs), j * bs, -1):
+                key = prompt[:length].tobytes()
+                entry = self._host_prefix.get(key)
+                if entry is not None:
+                    hit = (key, length - j * bs, entry[0])
+                    break
+            if hit is None:
+                break
+            chain.append(hit)
+            shared = j * bs + hit[1]
+            if hit[1] < bs:         # partial tail ends the chain
+                break
+            j += 1
+        return shared, chain
+
+    def prefetch(self, prompt) -> bool:
+        """Routing-time async upload: start moving ``prompt``'s
+        host-resident prefix blocks back into HBM so they are registered
+        (and shareable) before the request's slot boards. Returns True on
+        a prefetch HIT — a new upload started, or the same keys are
+        already in flight; False (a MISS) when the host tier adds nothing
+        past the device registry or availability cannot fund the upload
+        without touching reservations. Free blocks fund first; reclaimable
+        LRU blocks fund the rest by the allocator's own evict path — WITH
+        demotion, so the displaced prefix moves to host instead of dying
+        (the offload-thrash cycle under hot-prefix churn).
+
+        The uploaded keys stay INVISIBLE until :meth:`advance_transfers`
+        completes them; until then :meth:`can_admit` blocks any request
+        the in-flight keys prefix (``prefetch_blocked``) — boarding
+        against half-uploaded rows is the one way this tier could corrupt
+        a stream, so it is structurally impossible."""
+        if not self.host_cache_blocks:
+            return False
+        prompt = np.asarray(prompt, np.int32)
+        host_len, chain = self._probe_host(prompt)
+        dev_len = self._probe_prefix(prompt)[0]
+        chain = [(k, f, hid) for (k, f, hid) in chain
+                 if k not in self._prefix]
+        if host_len <= dev_len or not chain:
+            self.host_prefetch_misses_total += 1
+            return False
+        inflight_keys = {k for t in self._inflight
+                         for (k, _f, _hk, _hv) in t["entries"]}
+        fresh = [(k, f, hid) for (k, f, hid) in chain
+                 if k not in inflight_keys]
+        if not fresh:
+            return True             # already on its way; counted at start
+        n = len(fresh)
+        if n > self.blocks_available:
+            self.host_prefetch_misses_total += 1
+            return False
+        # capture the host arrays BEFORE claiming device blocks: claiming
+        # may evict-and-demote LRU victims, and the demotion's host-LRU
+        # overflow could drop the very entries this upload reads from
+        entries = []
+        for key, fill, hid in fresh:
+            e = self._host[hid]
+            self._host.move_to_end(hid)        # a prefetch touch is a use
+            entries.append((key, fill, e["kc"], e["vc"]))
+        blocks = []
+        for _ in range(n):
+            if self._free_blocks:
+                blocks.append(self._free_blocks.pop())
+                continue
+            # _alloc_block's eviction path, verbatim: oldest cached block
+            # demotes to host, its device keys drop, the block funds the
+            # upload (blocks_available already proved reservations survive)
+            block, _ = self._lru.popitem(last=False)
+            self._demote(block)
+            for k in list(self._cached.get(block, ())):
+                del self._prefix[k]
+            self._cached.pop(block, None)
+            self._registry_epoch += 1
+            self.evictions_total += 1
+            blocks.append(block)
+        self._inflight.append({"entries": entries, "blocks": blocks,
+                               "ticks_left": self.prefetch_ticks})
+        self.host_prefetch_hits_total += 1
+        return True
+
+    def prefetch_blocked(self, request) -> bool:
+        if not self._inflight:
+            return False
+        seq_b = np.asarray(_bind_seq_of(request), np.int32).tobytes()
+        for t in self._inflight:
+            for key, _f, _hk, _hv in t["entries"]:
+                if len(key) < len(seq_b) and seq_b.startswith(key):
+                    return True
+        return False
+
+    def advance_transfers(self) -> None:
+        """One engine tick of upload progress: decrement every in-flight
+        countdown and COMPLETE the ones that reach zero — device rows land,
+        the keys register (epoch bump), the blocks join the reclaimable LRU
+        as cached ref-0 blocks exactly as if a local request had registered
+        them. The paged engine calls this at the top of every step, BEFORE
+        admission, so a request blocked on its upload boards the same tick
+        the blocks become real. A key registered on-device while the upload
+        flew wins (first writer, the registry's one rule) and the upload's
+        block goes straight back to the free list."""
+        if not self._inflight:
+            return
+        import jax
+        done = [t for t in self._inflight if t["ticks_left"] <= 1]
+        for t in self._inflight:
+            t["ticks_left"] -= 1
+        self._inflight = [t for t in self._inflight if t["ticks_left"] > 0]
+        for t in done:
+            blocks = list(t["blocks"])
+            for key, fill, hk, hv in t["entries"]:
+                block = blocks.pop(0)
+                if key in self._prefix:
+                    self._free_blocks.append(block)
+                    continue
+                self.kc = jax.tree.map(
+                    lambda d, h: d.at[:, block].set(h), self.kc, hk)
+                self.vc = jax.tree.map(
+                    lambda d, h: d.at[:, block].set(h), self.vc, hv)
+                self._prefix[key] = (block, fill)
+                self._cached.setdefault(block, set()).add(key)
+                self._lru[block] = None        # cached ref-0, reclaimable
+                self._registry_epoch += 1
+                self.host_promotes_total += 1
+                self.host_transfer_bytes_total += self.bytes_per_block
+
+    def host_bytes_resident(self) -> int:
+        """Host-tier mirror of :meth:`bytes_resident`: bytes the offload
+        tier pins in host RAM, ``host blocks x bytes_per_block`` — the
+        same :func:`kv_block_bytes` formula, so the analyzer's host-tier
+        prediction reconciles exactly (``analysis/programs.py``)."""
+        return len(self._host) * self.bytes_per_block
+
     # -- tick inputs -------------------------------------------------------
 
     def device_table(self, slot: int) -> np.ndarray:
@@ -696,7 +964,7 @@ class PagedKVPool(_SlotPoolBase):
         return t
 
     def stats(self) -> dict:
-        return {
+        s = {
             "blocks_total": self.n_blocks,
             "blocks_in_use": self.blocks_in_use,
             "blocks_cached": self.blocks_cached,
@@ -706,3 +974,18 @@ class PagedKVPool(_SlotPoolBase):
             "cow_copies_total": self.cow_copies_total,
             "evictions_total": self.evictions_total,
         }
+        if self.host_cache_blocks:
+            s.update({
+                "host_blocks": len(self._host),
+                "host_bytes_resident": self.host_bytes_resident(),
+                "host_inflight_blocks": sum(
+                    len(t["blocks"]) for t in self._inflight),
+                "host_demotes_total": self.host_demotes_total,
+                "host_promotes_total": self.host_promotes_total,
+                "host_evictions_total": self.host_evictions_total,
+                "host_prefetch_hits_total": self.host_prefetch_hits_total,
+                "host_prefetch_misses_total":
+                    self.host_prefetch_misses_total,
+                "host_transfer_bytes_total": self.host_transfer_bytes_total,
+            })
+        return s

@@ -183,7 +183,8 @@ def engine_factory(stages, cfg, *, metrics=None, clock=time.monotonic,
             dcfg = dataclasses.replace(cfg, n_tensor_parallel=1)
         dkw = {k: v for k, v in kw.items()
                if k not in ("block_size", "n_blocks", "prefill_chunk",
-                            "kv_layout", "attn_kernel")}
+                            "kv_layout", "attn_kernel",
+                            "host_cache_blocks", "prefetch_ticks")}
         from simple_distributed_machine_learning_tpu.models.gpt import (
             _is_quantized_dtype,
         )
@@ -254,6 +255,10 @@ class ServeSupervisor:
         self.flight = flight
         self.postmortems: list[str] = []     # bundle paths, write order
         self._sheds_since_step = 0
+        # disaggregated-fleet role ("prefill" | "decode"; None outside a
+        # disaggregated fleet) — set by ServeFleet, stamped onto every
+        # flight-recorder row so post-mortems localize WHICH pool saturated
+        self.pool_role: str | None = None
         #: monotonic tick counter — unlike ``engine._tick_count`` it
         #: survives engine rebuilds, and it is the ``tick`` every journal
         #: record and flight-recorder row carries (the forensic join key)
@@ -378,7 +383,9 @@ class ServeSupervisor:
             self.flight.snap(self.engine, self.tick, emitted,
                              state=self.state, restarts=self.restarts,
                              degraded=self.degraded,
-                             load_degraded=self.load_degraded)
+                             load_degraded=self.load_degraded,
+                             **({} if self.pool_role is None
+                                else {"pool_role": self.pool_role}))
         if self._sheds_since_step >= self.shed_burst:
             self._dump_postmortem(
                 "shed_burst", f"{self._sheds_since_step} sheds in one tick")
@@ -578,10 +585,16 @@ class ServeSupervisor:
 
     # -- cross-replica migration (serve/fleet.py) ----------------------------
 
-    def adopt(self, request: Request, on_token=None) -> Request:
-        """Adopt a request migrated from ANOTHER replica whose host died.
+    def adopt(self, request: Request, on_token=None,
+              reason: str = "failure") -> Request:
+        """Adopt a request migrated from ANOTHER replica.
 
-        The full snapshot is journaled here FIRST (one ``snap`` record,
+        Two callers, one move: failure migration (the source replica's
+        host died; ``reason="failure"``, the default) and the
+        disaggregated fleet's planned prefill->decode handoff
+        (``reason="handoff"`` — the source released the request with
+        :meth:`release`). The full snapshot is journaled here FIRST (one
+        ``snap`` record carrying the cause under its ``why`` key,
         ``journal.py::log_snapshot``) so THIS replica's journal alone
         recovers the adoptee — a later crash of this replica, or a second
         replica loss on top of the first, replays it exactly like a native
@@ -589,8 +602,8 @@ class ServeSupervisor:
         ``engine.restore`` (the same preempt/resume path crash recovery
         uses, so the continued decode stays bit-exact); a DONE/SHED
         snapshot is adopted as a readable handle only. ``on_token`` is the
-        CALLER's streaming callback (the dead replica's wiring died with
-        it)."""
+        CALLER's streaming callback (the source replica's wiring died —
+        or was released — with it)."""
         if request.rid in self.requests:
             raise ValueError(
                 f"request {request.rid} already lives in this replica — "
@@ -600,7 +613,8 @@ class ServeSupervisor:
                 f"request {request.rid} is {request.state!r} — migration "
                 f"adopts journal snapshots (queued/done/shed), never a "
                 f"live engine's state")
-        self.journal.log_snapshot(request, tick=self.tick)
+        request.snap_reason = reason
+        self.journal.log_snapshot(request, tick=self.tick, reason=reason)
         self.requests[request.rid] = request
         if request.state == QUEUED:
             request.on_token = self._on_token
@@ -613,6 +627,59 @@ class ServeSupervisor:
             self.engine._next_rid = max(self.engine._next_rid,
                                         request.rid + 1)
         return request
+
+    def release(self, rid: int, dst=None) -> Request:
+        """Hand a LIVE request out of this replica — the source half of
+        the disaggregated fleet's prefill->decode handoff (the adopting
+        replica runs :meth:`adopt` with ``reason="handoff"``).
+
+        An ACTIVE request's slot and K/V blocks free immediately (the
+        preemption release path, so the handle carries its emitted tokens
+        and untouched key stream — re-admission on the destination
+        recomputes ``resume_seq`` and continues bit-exact); a QUEUED one
+        just leaves the queue. A ``handoff`` journal record marks the rid
+        as moved (``journal.py``): recovery of THIS journal drops it, so
+        losing this replica later can never double-serve the request.
+        Returns the handle (state QUEUED) for the destination to adopt."""
+        r = self.requests.get(rid)
+        if r is None:
+            raise ValueError(f"request {rid} does not live in this replica")
+        if r.state not in (QUEUED, ACTIVE):
+            raise ValueError(
+                f"request {rid} is {r.state!r} — only live "
+                f"(queued/active) requests hand off")
+        if r.state == ACTIVE:
+            # the preempt release path WITHOUT the preemption accounting
+            # (a planned handoff is not SLO-protective eviction): slot and
+            # blocks free now, state back to QUEUED with tokens intact
+            try:
+                self.engine._prefilling.remove(rid)   # may be mid-prefill
+            except ValueError:
+                pass
+            self.engine.pool.unbind_seq(r.slot)
+            self.engine.pool.release(r.slot)
+            r.slot = None
+            r.prefill_pos = None
+            r.state = QUEUED
+        else:
+            # identity scan, not deque.remove (Request.__eq__ compares
+            # prompt arrays — engine.cancel's same caveat)
+            for i, q in enumerate(self.engine.scheduler.queue):
+                if q is r:
+                    del self.engine.scheduler.queue[i]
+                    break
+            else:               # pragma: no cover - state-machine guard
+                raise RuntimeError(
+                    f"queued request {rid} missing from the scheduler "
+                    f"queue — lifecycle bookkeeping corrupted")
+        del self.engine.requests[rid]
+        self.engine._last_emit.pop(rid, None)
+        del self.requests[rid]
+        self._user_cb.pop(rid, None)
+        self._open.discard(rid)
+        r.on_token = None        # the destination's adopt() rewires it
+        self.journal.log_handoff(rid=rid, dst=dst, tick=self.tick)
+        return r
 
     # -- crash recovery -----------------------------------------------------
 

@@ -6,9 +6,11 @@
 request-scoped trace timeline(s) and any post-mortem bundles — as one
 summary: per-class SLO attainment, the shed breakdown, the restart
 timeline (journal ``restart`` events with their monotonic ticks), TTFT /
-TPOT quantiles, KV-drift, the training-resilience block (the self-healing
-sentinel's anomaly/rollback/quarantine counters and per-event timeline
-from the epoch records), and the bundle inventory. ``--json`` emits the
+TPOT quantiles, KV-drift, the disaggregated-pool block (per-role replica/
+queue/slot gauges plus the host offload tier's demote/promote/prefetch
+counters and the per-journal snap-cause split), the training-resilience
+block (the self-healing sentinel's anomaly/rollback/quarantine counters
+and per-event timeline from the epoch records), and the bundle inventory. ``--json`` emits the
 same content as one machine-readable object.
 
 This module is deliberately stdlib-only (``json``/``os``/``glob``/
@@ -70,11 +72,18 @@ def collect(outdir: str) -> dict:
     for path in sorted(glob.glob(os.path.join(outdir, "journal*.jsonl"))):
         events = _read_jsonl(path)
         counts: dict[str, int] = {}
+        snap_why: dict[str, int] = {}
         for ev in events:
             counts[ev.get("ev", "?")] = counts.get(ev.get("ev", "?"), 0) + 1
+            if ev.get("ev") == "snap":
+                # migration cause ("failure" vs "handoff"); reason-less
+                # snaps predate the field and count as "-"
+                why = ev.get("why") or "-"
+                snap_why[why] = snap_why.get(why, 0) + 1
         journals[os.path.basename(path)] = {
             "events": len(events),
             "by_kind": dict(sorted(counts.items())),
+            "snap_why": dict(sorted(snap_why.items())),
             "restarts": [
                 {"n": ev.get("n"), "cause": ev.get("cause"),
                  "degraded": ev.get("degraded"), "tick": ev.get("tick")}
@@ -220,7 +229,24 @@ def render(report: dict) -> str:
                 f"{s.get('fleet_migrations', 0)} migration(s), "
                 f"{s.get('route_affinity_hits', 0)} affinity hit(s), "
                 f"{s.get('fleet_scale_outs', 0)} scale-out(s), "
-                f"{s.get('fleet_retired', 0)} retired")
+                f"{s.get('fleet_retired', 0)} retired, "
+                f"{s.get('fleet_handoffs', 0)} handoff(s)")
+        for pool, blk in sorted((s.get("pools") or {}).items()):
+            lines.append(
+                f"  pool {pool}: {blk.get('replicas', 0)} replica(s), "
+                f"queue depth {blk.get('queue_depth', 0)}, "
+                f"{blk.get('slots_active', 0)} slot(s) active")
+        if "host_blocks" in s:
+            lines.append(
+                f"  host tier: {s['host_blocks']} block(s) resident "
+                f"({s.get('host_bytes_resident', 0)} bytes), "
+                f"{s.get('host_inflight_blocks', 0)} in flight, "
+                f"{s.get('host_demotes', 0)} demote(s), "
+                f"{s.get('host_promotes', 0)} promote(s), "
+                f"{s.get('host_evictions', 0)} eviction(s), prefetch "
+                f"{s.get('host_prefetch_hits', 0)} hit(s)/"
+                f"{s.get('host_prefetch_misses', 0)} miss(es), "
+                f"{s.get('host_transfer_bytes', 0)} bytes transferred")
         if "kv_drift_bytes" in s:
             ok = "OK" if s["kv_drift_bytes"] == 0 else "NONZERO"
             lines.append(
@@ -243,14 +269,29 @@ def render(report: dict) -> str:
                if "restarts" in scen else ""))
         fl = scen.get("fleet")
         if fl:
+            split = (f" = {fl['prefill_replicas']} prefill + "
+                     f"{fl.get('replicas', 0) - fl['prefill_replicas']} "
+                     f"decode" if fl.get("prefill_replicas") else "")
             lines.append(
-                f"    fleet: {fl.get('replicas')} replica(s) "
+                f"    fleet: {fl.get('replicas')} replica(s){split} "
                 f"(route {fl.get('route')}), "
                 f"{fl.get('replica_losses', 0)} loss(es), "
                 f"{fl.get('migrations', 0)} migration(s), "
                 f"{fl.get('affinity_hits', 0)} affinity hit(s), "
                 f"{fl.get('scale_outs', 0)} scale-out(s), "
-                f"{fl.get('retired', 0)} retired")
+                f"{fl.get('retired', 0)} retired"
+                + (f", {fl['handoffs']} handoff(s)"
+                   if "handoffs" in fl else ""))
+        ht = scen.get("host_tier")
+        if ht:
+            lines.append(
+                f"    host tier: {ht.get('host_cache_blocks')} block "
+                f"capacity, {ht.get('demotes', 0)} demote(s), "
+                f"{ht.get('promotes', 0)} promote(s), "
+                f"{ht.get('host_evictions', 0)} eviction(s), prefetch "
+                f"{ht.get('prefetch_hits', 0)} hit(s)/"
+                f"{ht.get('prefetch_misses', 0)} miss(es), "
+                f"{ht.get('transfer_bytes', 0)} bytes transferred")
         for cls, att in sorted((scen.get("slo") or {}).items()):
             gates = [f"{k.split('_')[0]} {_fmt(att[k])}"
                      for k in ("ttft_attainment", "tpot_attainment")
@@ -260,6 +301,10 @@ def render(report: dict) -> str:
     for name, j in report["journals"].items():
         lines.append(f"  journal {name}: {j['events']} events "
                      f"{j['by_kind']}")
+        why = {k: v for k, v in (j.get("snap_why") or {}).items()
+               if k != "-"}
+        if why:
+            lines.append(f"    snap cause: {why}")
         for r in j["restarts"]:
             lines.append(
                 f"    restart #{r['n']} @tick {_fmt(r['tick'])} "

@@ -303,7 +303,9 @@ def test_unknown_scenario_rejected():
     assert set(SCENARIOS) == {"steady", "burst-interactive", "multi-tenant",
                               "burst-slow-tick", "crash-serve",
                               "overload-shed", "fleet-replica-loss",
-                              "hot-prefix-skew", "fleet-autoscale-diurnal"}
+                              "hot-prefix-skew", "fleet-autoscale-diurnal",
+                              "disagg-prefill-heavy", "offload-churn",
+                              "handoff-replica-loss"}
 
 
 # ---------------------------------------------------------------------------
