@@ -66,7 +66,22 @@ def main(argv=None) -> int:
                    help="host-side AST lint: decode builders memoized "
                         "through _DECODE_BUILD_CACHE, no bypass call "
                         "sites in serve/ or tests/, no raw jax.jit in "
-                        "serve/ (pure ast, no tracing)")
+                        "serve/, journal writer/reader grammar "
+                        "cross-check (pure ast, no tracing)")
+    p.add_argument("--serve-protocol", action="store_true",
+                   help="bounded model checking of the serve fleet "
+                        "protocol: exhaustively explore every "
+                        "tick/crash/handoff/adopt/shed/prefetch/retire "
+                        "interleaving of an abstract 2-pool fleet to "
+                        "--depth and prove the no-double-serve / "
+                        "no-lost-request / refcount-conservation / "
+                        "boarding-gate invariants (pure stdlib, no jax; "
+                        "exit 2 on a violated invariant, each violation "
+                        "prints its counterexample + exported chaos "
+                        "schedule)")
+    p.add_argument("--depth", type=int, default=None, metavar="N",
+                   help="--serve-protocol exploration depth bound "
+                        "(default: the clean model's pinned depth 8)")
     p.add_argument("--fixture", default=None, metavar="NAME",
                    help="run one seeded fixture (see --list)")
     p.add_argument("--fixtures", action="store_true",
@@ -86,9 +101,9 @@ def main(argv=None) -> int:
         )
         print("rule families: ppermute-deadlock unreduced-gradient "
               "mesh-axis dtype-drift donation scatter-bounds "
-              "retrace-explosion sharded-state hostlint "
-              "kernel-oob kernel-unproven kernel-race kernel-tile "
-              "kernel-dtype-drift kernel-hbm")
+              "retrace-explosion sharded-state hostlint journal-grammar "
+              "protocol kernel-oob kernel-unproven kernel-race "
+              "kernel-tile kernel-dtype-drift kernel-hbm")
         print("fixtures:")
         for fx in FIXTURES.values():
             kind = "defect" if fx.defect else "clean"
@@ -96,9 +111,11 @@ def main(argv=None) -> int:
         return 0
 
     if not (args.hostlint or args.serve or args.serve_kernel or args.fixtures
-            or args.fixture is not None or args.dryrun is not None):
+            or args.serve_protocol or args.fixture is not None
+            or args.dryrun is not None):
         p.error("nothing to do: pass --dryrun N, --serve, --serve-kernel, "
-                "--hostlint, --fixture NAME, --fixtures or --list")
+                "--hostlint, --serve-protocol, --fixture NAME, --fixtures "
+                "or --list")
     if args.dryrun is not None and args.dryrun < 1:
         p.error(f"--dryrun needs a positive device count, got "
                 f"{args.dryrun}")
@@ -106,14 +123,15 @@ def main(argv=None) -> int:
     # Modes compose: every requested mode runs and the exit code ANDs the
     # results (a combined `--serve --hostlint` must not silently drop one
     # gate).  Bootstrap once, sized for the most demanding requested mode —
-    # --hostlint alone stays jax-free (pure ast; pinned by a purge-and-block
-    # subprocess test).
+    # --hostlint and --serve-protocol alone stay jax-free (pure ast /
+    # pure stdlib; pinned by a purge-and-block subprocess test).
     need = max(1 if (args.serve or args.serve_kernel) else 0,
                8 if (args.fixtures or args.fixture is not None) else 0,
                args.dryrun or 0)
     if need:
         _bootstrap_devices(need)
     ok = True
+    protocol_violated = False
 
     if args.hostlint:
         from simple_distributed_machine_learning_tpu.analysis.hostlint import (
@@ -165,6 +183,42 @@ def main(argv=None) -> int:
               f"{'kernel-clean' if kern_ok else 'FLAGGED'}")
         ok &= kern_ok
 
+    if args.serve_protocol:
+        import dataclasses as _dc
+        import os as _os
+
+        from simple_distributed_machine_learning_tpu.analysis.protocol import (
+            INVARIANTS,
+            CLEAN,
+            check_protocol,
+        )
+        cfg = CLEAN if args.depth is None else _dc.replace(
+            CLEAN, depth=args.depth)
+        report = check_protocol(cfg)
+        # the SDML_LINT_INJECT gate drill, mirrored inline (importing
+        # programs.py's helper would pull jax into this jax-free mode)
+        tag = _os.environ.get("SDML_LINT_INJECT")
+        if tag:
+            from simple_distributed_machine_learning_tpu.analysis.report import (  # noqa: E501
+                Finding,
+                Severity,
+            )
+            report.findings.append(Finding(
+                rule=f"injected.{tag}", severity=Severity.ERROR,
+                message="seeded ERROR finding injected via "
+                        "SDML_LINT_INJECT — the gate drill proving "
+                        "--lint preflights actually fail",
+                where="SDML_LINT_INJECT", hint="unset SDML_LINT_INJECT"))
+        print(report.format(costs=False))
+        print(f"model: {cfg.summary()}")
+        print(f"invariants: {', '.join(INVARIANTS)}")
+        print(f"verdict: {report.verdict}")
+        proto_ok = report.ok(args.fail_on or "error")
+        print(f"analysis --serve-protocol: "
+              f"{'clean' if proto_ok else 'FLAGGED'}")
+        ok &= proto_ok
+        protocol_violated |= not proto_ok
+
     if args.fixtures:
         from simple_distributed_machine_learning_tpu.analysis.fixtures import (
             self_test,
@@ -198,7 +252,10 @@ def main(argv=None) -> int:
               f"{len(reports)} steps {'clean' if dry_ok else 'FLAGGED'}")
         ok &= dry_ok
 
-    return 0 if ok else 1
+    # a violated protocol invariant is the loudest possible failure: its
+    # own exit code (2), distinct from ordinary lint findings (1), so CI
+    # and scripts can branch on "the protocol itself is broken"
+    return 0 if ok else (2 if protocol_violated else 1)
 
 
 if __name__ == "__main__":

@@ -520,6 +520,55 @@ def clean_pipeline_step() -> Report:
                    mesh=mesh, name="fixture:clean_pipeline_step")
 
 
+# -- protocol: seeded defects in the abstract fleet model ------------------
+#
+# Each builder runs the bounded model checker over a fleet model carrying
+# ONE protocol defect (a knob on ProtocolConfig that mirrors a real bug
+# class in serve/fleet.py + serve/supervisor.py).  The defect fixtures must
+# produce a `protocol.*` ERROR with a concrete counterexample trace; the
+# clean twin explores the same transition system with the defect knobs off
+# and must prove every invariant to its depth.  Pure stdlib — no jax.
+
+def protocol_dropped_handoff() -> Report:
+    from simple_distributed_machine_learning_tpu.analysis.protocol import (
+        DROPPED_TOMBSTONE,
+        check_protocol,
+    )
+    return check_protocol(DROPPED_TOMBSTONE)
+
+
+def protocol_legacy_handoff_order() -> Report:
+    from simple_distributed_machine_learning_tpu.analysis.protocol import (
+        LEGACY_ORDER,
+        check_protocol,
+    )
+    return check_protocol(LEGACY_ORDER)
+
+
+def protocol_skipped_refund() -> Report:
+    from simple_distributed_machine_learning_tpu.analysis.protocol import (
+        SKIPPED_REFUND,
+        check_protocol,
+    )
+    return check_protocol(SKIPPED_REFUND)
+
+
+def protocol_ungated_boarding() -> Report:
+    from simple_distributed_machine_learning_tpu.analysis.protocol import (
+        UNGATED_BOARDING,
+        check_protocol,
+    )
+    return check_protocol(UNGATED_BOARDING)
+
+
+def protocol_clean_fleet() -> Report:
+    from simple_distributed_machine_learning_tpu.analysis.protocol import (
+        CLEAN,
+        check_protocol,
+    )
+    return check_protocol(CLEAN)
+
+
 FIXTURES: dict[str, Fixture] = {f.name: f for f in [
     Fixture("partial_ppermute", "ppermute-deadlock", True,
             "ring permutation missing its wraparound hop", partial_ppermute),
@@ -558,6 +607,18 @@ FIXTURES: dict[str, Fixture] = {f.name: f for f in [
     Fixture("kernel_f16_accumulator", "kernel-dtype-drift", True,
             "f16 scratch accumulator carried across grid iterations",
             kernel_f16_accumulator),
+    Fixture("protocol_dropped_handoff", "protocol", True,
+            "handoff sealed without journaling the source tombstone",
+            protocol_dropped_handoff),
+    Fixture("protocol_legacy_handoff_order", "protocol", True,
+            "tombstone-then-copy handoff (pre-fix ordering, loses the rid)",
+            protocol_legacy_handoff_order),
+    Fixture("protocol_skipped_refund", "protocol", True,
+            "shed/preempt path that never refunds the KV block refcounts",
+            protocol_skipped_refund),
+    Fixture("protocol_ungated_boarding", "protocol", True,
+            "decode boarding not gated on the prefetch upload landing",
+            protocol_ungated_boarding),
     Fixture("clean_grad_sync", "", False,
             "the dropped_grad_sync fixture with the pmean restored",
             clean_grad_sync),
@@ -582,7 +643,113 @@ FIXTURES: dict[str, Fixture] = {f.name: f for f in [
     Fixture("kernel_f32_accumulator", "", False,
             "the grid kernel with its scratch accumulator in f32",
             kernel_f32_accumulator),
+    Fixture("protocol_clean_fleet", "", False,
+            "the 2-pool fleet model with every defect knob off (proves)",
+            protocol_clean_fleet),
 ]}
+
+
+def _replay_exported_drill() -> tuple[bool, list[str]]:
+    """Anti-vacuous gate for the model checker's counterexample export: the
+    FaultPlan exported from the dropped-tombstone model's double-serve
+    counterexample must replay as a REAL failure (more tokens streamed than
+    the request asked for) on a live 3-replica disaggregated fleet carrying
+    the same seeded defect (``log_handoff`` suppressed), and the intact
+    twin must stay exactly-once under the identical kill schedule.  Without
+    this, a model bug that exports unparseable or toothless schedules would
+    pass every purely-abstract check."""
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.analysis.protocol import (
+        DROPPED_TOMBSTONE,
+        check_protocol,
+        export_fault_plan,
+    )
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.resilience import faults
+    from simple_distributed_machine_learning_tpu.serve import (
+        RequestJournal,
+        ServeFleet,
+        engine_factory,
+    )
+    from simple_distributed_machine_learning_tpu.serve.request import DONE
+
+    lines = []
+    report = check_protocol(DROPPED_TOMBSTONE)
+    viol = next((v for v in report.exploration.violations
+                 if v.invariant == "double-serve"), None)
+    if viol is None:
+        return False, ["== exported-drill replay: model found no "
+                       "double-serve counterexample -> FAILED"]
+    plan_text, note = export_fault_plan(viol)
+    if plan_text is None:
+        return False, [f"== exported-drill replay: counterexample not "
+                       f"expressible as a FaultPlan ({note}) -> FAILED"]
+    lines.append(f"  exported plan: {plan_text}")
+
+    cfg = GPTConfig(vocab=32, seq_len=48, d_model=32, n_heads=2, n_layers=2)
+    stages = make_gpt_stages(jax.random.key(0), cfg, 2)[0]
+    prompt = np.asarray(
+        jax.random.randint(jax.random.key(7), (4,), 0, cfg.vocab), np.int32)
+    max_new = 3
+
+    def run(drop_tombstone: bool) -> int:
+        """Drive the model's scenario (submit -> prefill -> handoff ->
+        DONE), then install the exported plan and keep ticking; returns
+        total tokens streamed to the caller over the whole run."""
+        faults.uninstall()
+        orig = RequestJournal.log_handoff
+        if drop_tombstone:
+            RequestJournal.log_handoff = lambda self, **kw: None
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                fleet = ServeFleet(
+                    engine_factory(stages, cfg, n_slots=2, block_size=4,
+                                   prefill_chunk=3),
+                    os.path.join(td, "j"), n_replicas=3,
+                    prefill_replicas=1, journal_sync=False)
+                got = []
+                h = fleet.submit(prompt, max_new_tokens=max_new, seed=11,
+                                 on_token=lambda req, tok: got.append(tok))
+                for _ in range(60):
+                    fleet.step()
+                    if h.state == DONE and fleet.handoffs >= 1:
+                        break
+                faults.install(faults.FaultPlan.parse(plan_text))
+                for _ in range(len(plan_text.split(";")) + 1):
+                    fleet.step()
+                faults.uninstall()
+                for _ in range(60):
+                    if h.state == DONE:
+                        break
+                    fleet.step()
+                fleet.close()
+                return len(got)
+        finally:
+            RequestJournal.log_handoff = orig
+            faults.uninstall()
+
+    defect_tokens = run(drop_tombstone=True)
+    clean_tokens = run(drop_tombstone=False)
+    defect_good = defect_tokens > max_new
+    clean_good = clean_tokens == max_new
+    lines.append(f"  defect twin (log_handoff dropped): streamed "
+                 f"{defect_tokens}/{max_new} tokens -> "
+                 f"{'double-served as predicted' if defect_good else 'NO REAL FAILURE (vacuous export)'}")  # noqa: E501
+    lines.append(f"  clean twin (tombstone intact):     streamed "
+                 f"{clean_tokens}/{max_new} tokens -> "
+                 f"{'exactly-once' if clean_good else 'UNEXPECTED FAILURE'}")
+    ok = defect_good and clean_good
+    lines.insert(0, f"== exported-drill replay: counterexample must fail a "
+                    f"real fleet -> {'OK' if ok else 'FAILED'}")
+    return ok, lines
 
 
 def self_test() -> tuple[bool, str]:
@@ -614,4 +781,7 @@ def self_test() -> tuple[bool, str]:
     for g in gaps:
         lines.append(f"  MISSING: {g}")
         ok = False
+    replay_ok, replay_lines = _replay_exported_drill()
+    ok = ok and replay_ok
+    lines.extend(replay_lines)
     return ok, "\n".join(lines)

@@ -25,7 +25,15 @@ catch with ``ast`` and expensive to catch in production:
   journal-replay determinism contract (PRs 10-11) hold ONLY because every
   clock read goes through the injectable plumbing (``clock=`` default
   args, the simulator's VirtualClock) — referencing ``time.monotonic`` as
-  a default is sanctioned, calling it inline is not.
+  a default is sanctioned, calling it inline is not;
+- ``journal-grammar.unread-event`` — a journal event kind some writer in
+  ``serve/`` emits (a dict display with a constant ``"ev"`` key) that NO
+  reader dispatches on: neither ``serve/journal.py::recover_state`` (the
+  crash-recovery fold) nor the telemetry report reader compares the
+  ``"ev"`` field against it. A record type nobody reads silently vanishes
+  on recovery — the exact failure mode the protocol model checker
+  (analysis/protocol.py) assumes away, so the grammar cross-check is what
+  keeps the abstraction honest against the real writers.
 
 Pure ``ast`` — no jax import, so the CI lint job runs it in milliseconds:
 ``python -m simple_distributed_machine_learning_tpu.analysis --hostlint``.
@@ -267,6 +275,114 @@ def _lint_call_sites(path: str, allow_jit: bool,
     return findings
 
 
+JOURNAL_PATH = os.path.join(_PKG, "serve", "journal.py")
+TELEMETRY_REPORT_PATH = os.path.join(_PKG, "telemetry", "report.py")
+
+
+def _is_ev_load(expr) -> bool:
+    """``<x>["ev"]`` or ``<x>.get("ev", ...)`` — the two spellings the
+    journal readers use to pull a record's event kind. Keyed on the
+    literal ``"ev"`` so ``r.get("kind")`` dispatches (metrics records)
+    never count as journal reads."""
+    if (isinstance(expr, ast.Subscript)
+            and isinstance(expr.slice, ast.Constant)
+            and expr.slice.value == "ev"):
+        return True
+    return (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "get" and expr.args
+            and isinstance(expr.args[0], ast.Constant)
+            and expr.args[0].value == "ev")
+
+
+def _event_writes(path: str, repo: str = _REPO) -> list:
+    """``(kind, where)`` for every journal record literal in a module: a
+    dict display carrying a constant ``"ev"`` key with a constant string
+    value — the shape every ``RequestJournal.log_*`` writer uses."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and k.value == "ev"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                out.append((v.value, _where(path, node, repo)))
+    return out
+
+
+def _event_reads(path: str) -> set:
+    """Every event kind a reader module dispatches on: string constants
+    compared (``==`` or ``in (...)``) against a value that came from the
+    ``"ev"`` key — directly (``ev.get("ev") == "restart"``) or through a
+    variable (``kind = ev["ev"]; ... kind == "submit"``)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    kind_vars: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_ev_load(node.value):
+            kind_vars.update(t.id for t in node.targets
+                             if isinstance(t, ast.Name))
+    kinds: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not (_is_ev_load(node.left)
+                or (isinstance(node.left, ast.Name)
+                    and node.left.id in kind_vars)):
+            continue
+        comp = node.comparators[0]
+        if (isinstance(node.ops[0], ast.Eq)
+                and isinstance(comp, ast.Constant)
+                and isinstance(comp.value, str)):
+            kinds.add(comp.value)
+        elif (isinstance(node.ops[0], ast.In)
+                and isinstance(comp, (ast.Tuple, ast.List, ast.Set))):
+            kinds.update(e.value for e in comp.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    return kinds
+
+
+def lint_journal_grammar(writer_paths=None, reader_paths=None,
+                         repo: str = _REPO) -> list[Finding]:
+    """The writer/reader cross-check: every event kind any ``serve/``
+    writer emits must have a dispatching reader in ``recover_state`` or
+    the telemetry report — AST-checked, so a new record type can never
+    silently vanish on recovery. Paths are parameterizable so the tests
+    can lint seeded-defect modules."""
+    if writer_paths is None:
+        serve_dir = os.path.join(_PKG, "serve")
+        writer_paths = [os.path.join(serve_dir, f)
+                        for f in sorted(os.listdir(serve_dir))
+                        if f.endswith(".py")]
+    if reader_paths is None:
+        reader_paths = [JOURNAL_PATH, TELEMETRY_REPORT_PATH]
+    read: set = set()
+    for p in reader_paths:
+        read |= _event_reads(p)
+    findings: list[Finding] = []
+    for p in writer_paths:
+        for kind, where in _event_writes(p, repo):
+            if kind not in read:
+                findings.append(Finding(
+                    rule="journal-grammar.unread-event",
+                    severity=Severity.ERROR,
+                    message=(f"journal event kind '{kind}' is written "
+                             f"here but NO reader dispatches on it — "
+                             f"neither recover_state nor the telemetry "
+                             f"report compares the 'ev' field against "
+                             f"'{kind}', so the record silently vanishes "
+                             f"on recovery/replay"),
+                    where=where,
+                    hint="add a recover_state branch (or a report reader) "
+                         "for the new kind, and a transition for it in "
+                         "the protocol model (analysis/protocol.py)"))
+    return findings
+
+
 def lint_repo(repo: str = _REPO) -> Report:
     """The whole hostlint suite: builder definitions in models/gpt.py;
     cache-poke and builder-bypass EVERYWHERE outside the cache's owner —
@@ -279,6 +395,7 @@ def lint_repo(repo: str = _REPO) -> Report:
                        "simple_distributed_machine_learning_tpu")
     gpt = os.path.abspath(os.path.join(pkg, "models", "gpt.py"))
     findings = lint_builder_definitions(gpt)
+    findings.extend(lint_journal_grammar(repo=repo))
     serve_dir = os.path.abspath(os.path.join(pkg, "serve")) + os.sep
     paths: list[str] = []
     for d in (pkg, os.path.join(repo, "tests")):

@@ -978,6 +978,18 @@ def _run_serve(args, n_stages: int, key) -> None:
         print(report.format(costs=True))
         if not report.ok():
             raise SystemExit(2)
+        # the protocol gate rides the same preflight: bounded model check
+        # of the fleet snap/adopt/handoff discipline (pure stdlib, <1s) —
+        # a serving stack whose PROTOCOL double-serves is as broken as one
+        # whose kernels scatter out of bounds
+        from simple_distributed_machine_learning_tpu.analysis.protocol import (
+            check_protocol,
+        )
+        proto = check_protocol()
+        print(f"| serve --lint protocol: {proto.verdict}")
+        if not proto.ok():
+            print(proto.format(costs=False))
+            raise SystemExit(2)
         print("| serve --lint: preflight clean")
         if args.lint_only:
             return

@@ -88,6 +88,14 @@ Injection sites threaded through the stack:
                           site once per alive replica per tick, so
                           ``rank=N`` targets replica N and a rank-less spec
                           kills the lowest-indexed alive replica)
+- ``fleet.handoff``       (``serve/fleet.py::_handoff_step``, ctx: ``step``
+                          = fleet tick, ``rank`` = SOURCE replica index —
+                          probed once per completed handoff, exactly
+                          between the destination's ``adopt`` and the
+                          source's tombstone seal: a ``replica-kill`` here
+                          is the kill-racing-adopt schedule the protocol
+                          model checker (analysis/protocol.py) explores
+                          and exports)
 - ``watchdog.heartbeat``  (``utils/failure.py``, ctx: ``rank``)
 - ``bench.probe``         (``bench.py``, ctx: ``step`` = probe attempt)
 
@@ -120,7 +128,7 @@ KINDS = ("host-kill", "frozen-peer", "slow-tick", "ckpt-write-crash",
 
 SITES = ("train.step", "train.grad", "data.batch", "train.sigterm",
          "ckpt.write", "serve.tick", "serve.admit", "fleet.tick",
-         "watchdog.heartbeat", "bench.probe")
+         "fleet.handoff", "watchdog.heartbeat", "bench.probe")
 
 #: kinds the numeric-anomaly sentinel (``resilience/sentinel.py``)
 #: interprets itself — a plan containing one of these needs a
@@ -135,7 +143,14 @@ SENTINEL_KINDS = ("nan-grad", "corrupt-batch", "loss-spike")
 _KIND_SITE = {"replica-kill": "fleet.tick", "nan-grad": "train.grad",
               "corrupt-batch": "data.batch", "preempt": "train.sigterm",
               "loss-spike": "train.step"}
-_SITE_KINDS = {"fleet.tick": ("replica-kill",), "train.grad": ("nan-grad",),
+#: secondary interpreting sites for kinds whose primary lives in
+#: ``_KIND_SITE`` (which stays single-valued: it doubles as the
+#: random-schedule and coverage default). ``replica-kill`` is also
+#: interpreted at ``fleet.handoff`` — the adopt/seal race probe.
+_KIND_EXTRA_SITES = {"replica-kill": ("fleet.handoff",)}
+_SITE_KINDS = {"fleet.tick": ("replica-kill",),
+               "fleet.handoff": ("replica-kill",),
+               "train.grad": ("nan-grad",),
                "data.batch": ("corrupt-batch",),
                "train.sigterm": ("preempt",)}
 
@@ -218,13 +233,15 @@ class FaultSpec:
                 f"unknown fault site {self.site!r}; instrumented sites: "
                 f"{SITES}")
         pinned = _KIND_SITE.get(self.kind)
-        if pinned is not None and self.site != pinned:
-            # a kind with exactly one interpreting site scheduled anywhere
-            # else would match-and-count without ever taking effect — the
-            # vacuous-drill failure the strict site check exists to stop
+        if (pinned is not None and self.site != pinned
+                and self.site not in _KIND_EXTRA_SITES.get(self.kind, ())):
+            # a kind with a closed set of interpreting sites scheduled
+            # anywhere else would match-and-count without ever taking
+            # effect — the vacuous-drill failure the strict check stops
+            allowed = (pinned,) + _KIND_EXTRA_SITES.get(self.kind, ())
             raise ValueError(
                 f"kind {self.kind!r} at site {self.site!r}: this kind only "
-                f"pairs with site {pinned!r} (its sole interpreter)")
+                f"pairs with {allowed} (its interpreting sites)")
         allowed = _SITE_KINDS.get(self.site)
         if allowed is not None and self.kind not in allowed:
             raise ValueError(
@@ -452,29 +469,39 @@ def drill_coverage(root: str | None = None, kinds=None, sites=None,
                    pairs=None) -> list[str]:
     """The chaos-coverage lint: every registered fault kind and every
     instrumented site must be FIRED by at least one test or CI drill, and
-    every pinned kind<->site pair (``_KIND_SITE``) must be drilled as that
-    exact pair — a new kind/site added without a drill currently passes
+    every pinned kind<->site pair (``_KIND_SITE`` plus the
+    ``_KIND_EXTRA_SITES`` secondaries) must be drilled as that exact
+    pair — a new kind/site added without a drill currently passes
     vacuously, which is the one failure mode a deterministic chaos harness
-    cannot tolerate. Scans ``tests/*.py`` and ``.github/workflows/*.yml``
-    for the ``kind@site`` schedule grammar and keyword ``FaultSpec(...)``
-    constructions. Returns a list of human-readable gaps (empty = fully
-    covered); the analysis CLI's ``--fixtures`` self-test runs it as an
-    extra contract line."""
+    cannot tolerate. Scans ``tests/*.py``, ``.github/workflows/*.yml`` and
+    the model checker's exported counterexample schedules
+    (``tests/data/protocol_drills/*.chaos`` — analysis/protocol.py's
+    ``render_drill`` artifacts, so a proved-and-exported interleaving
+    counts as drill coverage) for the ``kind@site`` schedule grammar and
+    keyword ``FaultSpec(...)`` constructions. Returns a list of
+    human-readable gaps (empty = fully covered); the analysis CLI's
+    ``--fixtures`` self-test runs it as an extra contract line."""
     import re
 
     kinds = tuple(kinds if kinds is not None else KINDS)
     sites = tuple(sites if sites is not None else SITES)
-    pairs = dict(pairs if pairs is not None else _KIND_SITE)
+    if pairs is not None:
+        required_pairs = set(dict(pairs).items())
+    else:
+        required_pairs = set(_KIND_SITE.items()) | {
+            (k, s) for k, extra in _KIND_EXTRA_SITES.items()
+            for s in extra}
     if root is None:
         root = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                             os.pardir, os.pardir))
     texts = []
-    for sub in ("tests", os.path.join(".github", "workflows")):
+    for sub in ("tests", os.path.join(".github", "workflows"),
+                os.path.join("tests", "data", "protocol_drills")):
         d = os.path.join(root, sub)
         if not os.path.isdir(d):
             continue
         for fname in sorted(os.listdir(d)):
-            if fname.endswith((".py", ".yml", ".yaml")):
+            if fname.endswith((".py", ".yml", ".yaml", ".chaos")):
                 try:
                     with open(os.path.join(d, fname),
                               encoding="utf-8") as fh:
@@ -506,8 +533,9 @@ def drill_coverage(root: str | None = None, kinds=None, sites=None,
         if s not in fired_sites:
             gaps.append(f"fault site {s!r} is instrumented but no test/CI "
                         f"drill ever fires it")
-    for k, s in pairs.items():
+    for k, s in sorted(required_pairs):
         if k in kinds and s in sites and (k, s) not in fired:
-            gaps.append(f"pinned pair {k}@{s} (the kind's sole "
-                        f"interpreting site) is never drilled as that pair")
+            gaps.append(f"pinned pair {k}@{s} (one of the kind's "
+                        f"interpreting sites) is never drilled as that "
+                        f"pair")
     return gaps

@@ -44,12 +44,22 @@ fleet splits into two independently-sized pools: the router admits new
 work to PREFILL replicas only, and the tick a request finishes prefill
 (seated, first token emitted) the fleet fires the SAME journal
 ``snap``/``adopt`` move used for failure migration as a planned
-**handoff** onto a decode replica — ``ServeSupervisor.release`` drops it
-from the source (journaling a terminal ``handoff`` event, so a later
-loss of the source replica can never re-adopt/double-serve it) and
-``adopt(reason="handoff")`` re-admits it on the destination, which makes
-every handed-off token stream bit-exact vs the symmetric single-pool
-run (tests/test_disagg.py pins f32 and int8, greedy and sampled).
+**handoff** onto a decode replica, in copy-then-tombstone order:
+``ServeSupervisor.release(seal=False)`` detaches it from the source
+WITHOUT journaling, ``adopt(reason="handoff")`` lands the full snapshot
+in the destination's journal, and only then does
+``ServeSupervisor.seal_handoff`` journal the terminal ``handoff`` event
+on the source (so a later loss of the source can never
+re-adopt/double-serve it). The ordering is load-bearing: at every crash
+point the rid is recoverable from at least one journal — the reverse
+order has a window where it lives in none, the ``protocol.lost-request``
+counterexample the bounded model checker (analysis/protocol.py) exports.
+Between adopt and seal the fleet probes the ``fleet.handoff`` fault
+site: a replica-kill there is the kill-racing-adopt schedule, and
+``_lose_replica``'s live-elsewhere guard is what keeps it exactly-once.
+Every handed-off token stream stays bit-exact vs the symmetric
+single-pool run (tests/test_disagg.py pins f32 and int8, greedy and
+sampled).
 Decode replicas are where the host offload tier pays off
 (``host_cache_blocks``): the router knows the prompt BEFORE admission,
 so a host-tier-resident prefix on a decode replica starts its async
@@ -470,21 +480,36 @@ class ServeFleet:
         prefill replica that FINISHED its prefill this tick (seated,
         first token emitted, still decoding) moves to the decode pool by
         the same journal ``snap``/``adopt`` discipline a replica loss
-        uses — ``release`` journals a terminal ``handoff`` event on the
-        source (no double-serve if the source dies later) and
-        ``adopt(reason="handoff")`` snapshots it into the destination's
-        journal before re-admission, so the continued stream is bit-exact
-        vs never having moved. Routed per request through the SAME router
-        (affinity first): a prefix the routing-time prefetch landed in
-        the destination's HBM makes the handoff an affinity hit."""
-        decode = self._role_candidates("decode")
+        uses, in copy-then-tombstone order — ``release(seal=False)``
+        detaches without journaling, ``adopt(reason="handoff")`` lands
+        the snapshot in the destination's journal, ``seal_handoff``
+        journals the terminal ``handoff`` event on the source last. At
+        every crash point the rid is recoverable from at least one
+        journal: an adoption crash (serve.admit faults exhausting the
+        destination's restart budget) happens AFTER the snap landed, so
+        losing the destination recovers it; the ``fleet.handoff`` fault
+        site between adopt and seal is the replica-kill-racing-adopt
+        schedule the model checker explores, where ``_lose_replica``'s
+        live-elsewhere guard keeps the unsealed source journal from
+        re-adopting the copy the destination already serves. Routed per
+        request through the SAME router (affinity first): a prefix the
+        routing-time prefetch landed in the destination's HBM makes the
+        handoff an affinity hit."""
+        from simple_distributed_machine_learning_tpu.resilience.supervisor import (  # noqa: E501
+            RestartBudgetExceeded,
+        )
         for src in self._role_alive("prefill"):
             sup = src.supervisor
             ready = sorted(
                 rid for rid, h in sup.requests.items()
                 if h.state == ACTIVE and h.prefill_pos is None
                 and h.tokens)
+            src_lost = False
             for rid in ready:
+                # candidates recomputed per rid: an adoption crash or a
+                # fleet.handoff kill earlier in THIS sweep may have
+                # shrunk the decode pool
+                decode = self._role_candidates("decode")
                 cand = [r for r in decode if r is not src] or decode
                 h = sup.requests[rid]
                 dst, hit = self.router.route(h.prompt, cand)
@@ -496,13 +521,41 @@ class ServeFleet:
                     self.metrics.on_affinity_hit()
                 if self.trace is not None:
                     self.trace.on_migrate(h, self._now, src.idx, dst.idx)
-                h = sup.release(rid, dst=dst.idx)
-                dst.supervisor.adopt(h, on_token=self._user_cb.get(rid),
-                                     reason="handoff")
+                h = sup.release(rid, dst=dst.idx, seal=False)
+                try:
+                    dst.supervisor.adopt(h, on_token=self._user_cb.get(rid),
+                                         reason="handoff")
+                except RestartBudgetExceeded as e:
+                    # the destination crashed admitting the adoptee — but
+                    # adopt() journals the snap before restore runs, so
+                    # the rid recovers from the dead journal like any
+                    # replica loss (and may re-adopt back onto src, which
+                    # is why the source's tombstone was deferred)
+                    self._lose_replica(
+                        dst, f"RestartBudgetExceeded@handoff: {e}")
+                    continue
                 self._home[rid] = dst.idx
                 self.handoffs += 1
                 if self.metrics is not None:
                     self.metrics.on_handoff()
+                # the fleet.handoff fault site: the probe sits exactly in
+                # the adopt->seal window (the kill-racing-adopt schedule
+                # exported counterexamples replay)
+                for spec in faults.check("fleet.handoff", step=self.tick,
+                                         rank=src.idx):
+                    if spec.kind == "replica-kill":
+                        self._lose_replica(
+                            src, f"replica-kill@handoff(rid={rid})")
+                        src_lost = True
+                        break
+                if src_lost:
+                    break
+                if rid not in sup.requests:
+                    # adoption-crash recovery can route the rid back home;
+                    # a tombstone AFTER that snap would drop it on replay
+                    sup.seal_handoff(rid, dst=dst.idx)
+            if src_lost:
+                continue
 
     def drain(self, max_ticks: int | None = None) -> list[Request]:
         from simple_distributed_machine_learning_tpu.serve.engine import (
@@ -583,6 +636,15 @@ class ServeFleet:
         snapshots = recover_state(read_journal(rep.journal_path)[0])
         inflight = []
         for rid in sorted(snapshots):
+            if any(r.alive and rid in r.supervisor.requests
+                   for r in self.replicas if r is not rep):
+                # the live-elsewhere guard: the rid already lives on a
+                # survivor — a handoff adopt landed but the source died
+                # before sealing its tombstone (the fleet.handoff kill
+                # racing adopt), so the dead journal's copy is stale.
+                # Re-adopting it would double-serve; rewinding the fleet
+                # handle to the stale prefix would corrupt the live stream
+                continue
             h = self.requests.get(rid)
             if h is None:
                 # the submission whose admission crash killed this replica:

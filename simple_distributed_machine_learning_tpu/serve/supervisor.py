@@ -628,7 +628,7 @@ class ServeSupervisor:
                                         request.rid + 1)
         return request
 
-    def release(self, rid: int, dst=None) -> Request:
+    def release(self, rid: int, dst=None, seal: bool = True) -> Request:
         """Hand a LIVE request out of this replica — the source half of
         the disaggregated fleet's prefill->decode handoff (the adopting
         replica runs :meth:`adopt` with ``reason="handoff"``).
@@ -640,7 +640,15 @@ class ServeSupervisor:
         just leaves the queue. A ``handoff`` journal record marks the rid
         as moved (``journal.py``): recovery of THIS journal drops it, so
         losing this replica later can never double-serve the request.
-        Returns the handle (state QUEUED) for the destination to adopt."""
+        Returns the handle (state QUEUED) for the destination to adopt.
+
+        ``seal=False`` defers the terminal ``handoff`` record to a later
+        :meth:`seal_handoff` — the copy-then-tombstone ordering the fleet
+        uses: journaling the tombstone here, BEFORE the destination's
+        ``adopt`` snap lands, opens a window where the rid lives in NO
+        journal, so a crash between the two appends loses the request
+        (the model checker's ``protocol.lost-request`` counterexample,
+        analysis/protocol.py::LEGACY_ORDER)."""
         r = self.requests.get(rid)
         if r is None:
             raise ValueError(f"request {rid} does not live in this replica")
@@ -678,8 +686,21 @@ class ServeSupervisor:
         self._user_cb.pop(rid, None)
         self._open.discard(rid)
         r.on_token = None        # the destination's adopt() rewires it
-        self.journal.log_handoff(rid=rid, dst=dst, tick=self.tick)
+        if seal:
+            self.journal.log_handoff(rid=rid, dst=dst, tick=self.tick)
         return r
+
+    def seal_handoff(self, rid: int, dst=None) -> None:
+        """Journal the terminal ``handoff`` tombstone for a rid this
+        replica already released with ``seal=False`` — called by the fleet
+        AFTER the destination's ``adopt`` journaled its snap, so at every
+        crash point the rid is recoverable from at least one journal (and
+        from at most one once this lands)."""
+        if rid in self.requests:
+            raise ValueError(
+                f"request {rid} still lives in this replica — seal only "
+                f"what release() already detached")
+        self.journal.log_handoff(rid=rid, dst=dst, tick=self.tick)
 
     # -- crash recovery -----------------------------------------------------
 

@@ -353,4 +353,4 @@ def test_severity_ordering_and_families():
                     "dtype-drift", "donation", "scatter-bounds",
                     "retrace-explosion", "sharded-state",
                     "kernel-oob", "kernel-race", "kernel-tile",
-                    "kernel-dtype-drift"}
+                    "kernel-dtype-drift", "protocol"}

@@ -707,8 +707,13 @@ def test_replica_kill_fault_kind_plumbing():
     # count as fired without ever taking effect — refused at parse time
     with pytest.raises(ValueError, match="only interprets"):
         faults.FaultPlan.parse("engine-crash@fleet.tick=2")
-    with pytest.raises(ValueError, match="only pairs with site"):
+    with pytest.raises(ValueError, match="only pairs with"):
         faults.FaultPlan.parse("replica-kill@serve.tick=2")
+    # ...but the secondary interpreting site (the adopt/seal race probe
+    # in _handoff_step) is a valid pairing
+    [spec] = faults.FaultPlan.parse("replica-kill@fleet.handoff,rank=0").specs
+    assert (spec.kind, spec.site, spec.rank) == \
+        ("replica-kill", "fleet.handoff", 0)
 
 
 # ---------------------------------------------------------------------------

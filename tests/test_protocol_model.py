@@ -1,0 +1,429 @@
+"""Bounded model checking of the serve fleet protocol (ISSUE 18).
+
+Pins the load-bearing claims:
+
+- **Statespace corners** — hash-dedup soundness (a diamond's join state is
+  explored once), depth-bound honesty (verdicts say "proved to depth N",
+  never a bare "proved"; a state cap proves nothing), byte-identical
+  reports across runs (sorted labels, no clock, no RNG).
+- **The proof** — the clean 2-pool fleet model proves no-double-serve /
+  no-lost-request / refcount-conservation / boarding-gate to depth >= 8
+  with a crash and a handoff in budget, in well under the 60s bar.
+- **Seeded defects find real counterexamples** — each defect knob
+  (dropped tombstone, legacy tombstone-then-copy order, skipped shed
+  refund, ungated boarding) yields a `protocol.*` ERROR whose exported
+  FaultPlan parses, or is honestly marked model-only (whole-host crash).
+- **Abstract-recovery fidelity** — ``abstract_recover`` folds REAL journal
+  records (including tick-less / why-less OLD-grammar journals) to the
+  same per-rid (state, n_tokens) picture ``recover_state`` rebuilds.
+- **Journal-grammar lint** — every event kind a serve/ writer emits has a
+  dispatching reader; a seeded writer emitting an unread kind is an ERROR.
+- **The fix, drilled on the real fleet** — the pinned
+  ``replica-kill@fleet.handoff`` drill (the adopt/seal race that lost
+  requests under the old handoff order) completes exactly-once on the
+  shipped fleet.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from simple_distributed_machine_learning_tpu.analysis.protocol import (
+    CLEAN,
+    DROPPED_TOMBSTONE,
+    INVARIANTS,
+    LEGACY_ORDER,
+    SKIPPED_REFUND,
+    UNGATED_BOARDING,
+    abstract_recover,
+    check_protocol,
+    export_fault_plan,
+    load_drill,
+    render_drill,
+)
+from simple_distributed_machine_learning_tpu.analysis.statespace import (
+    Violation,
+    explore,
+)
+from simple_distributed_machine_learning_tpu.resilience import faults
+
+DRILLS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "data", "protocol_drills")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ---- 1. statespace corners ------------------------------------------------
+
+def test_dedup_diamond_explores_join_once():
+    """Two paths into one state: its successors run once, and the second
+    arrival is a dedup hit, not a new state. States are values, so the
+    join genuinely collides."""
+    def trans(s):
+        if s == "i":
+            return [(("a",), "A"), (("b",), "B")]
+        if s in ("A", "B"):
+            return [(("join",), "T")]
+        if s == "T":
+            return [(("tail",), "Z")]
+        return []
+    res = explore("i", trans, {}, depth=10)
+    assert res.states == 5                 # i, A, B, T, Z — not 6
+    assert res.dedup_hits == 1             # the second edge into T
+    assert res.transitions == 5
+    assert res.complete
+
+
+def test_ghost_state_differences_are_not_deduped():
+    """Histories that differ in observable bookkeeping are different
+    states: dedup must key the whole value, or a violated counter could
+    hide behind a structurally-similar state."""
+    def trans(s):
+        path, count = s
+        if path == "i":
+            return [(("cheap",), ("T", count)), (("costly",), ("T", count + 1))]
+        return []
+    res = explore(("i", 0), trans, {}, depth=3)
+    assert res.states == 3 and res.dedup_hits == 0
+
+
+def test_depth_bound_honesty():
+    """A cut frontier is reported: "proved to depth N ... deeper schedules
+    unexplored". An exhausted space still carries its bound. Neither
+    phrasing ever degenerates to a bare "proved"."""
+    def unbounded(s):
+        return [(("inc",), s + 1)]
+    res = explore(0, unbounded, {}, depth=4)
+    v = res.verdict(["inv"])
+    assert "proved to depth 4" in v and "deeper schedules unexplored" in v
+    assert not res.complete
+
+    def finite(s):
+        return [(("inc",), s + 1)] if s < 2 else []
+    res2 = explore(0, finite, {}, depth=10)
+    v2 = res2.verdict(["inv"])
+    assert res2.complete
+    assert "proved to depth 10" in v2 and "state space exhausted" in v2
+
+    for verdict in (v, v2):
+        assert "proved" not in verdict.replace("proved to depth", "")
+
+
+def test_state_cap_is_never_a_proof():
+    def unbounded(s):
+        return [(("inc",), s + 1)]
+    res = explore(0, unbounded, {}, depth=100, max_states=5)
+    assert res.truncated and not res.complete
+    v = res.verdict(["inv"])
+    assert "inconclusive" in v and "nothing proved" in v
+    assert "proved to depth" not in v
+
+
+def test_bfs_counterexample_is_shortest():
+    """BFS order guarantees the first witness of a violation is minimal —
+    the counterexample a human debugs should be the 2-step one, not a
+    12-step interleaving that happens to be found first."""
+    def trans(s):
+        return [(("inc",), s + 1), (("double",), s * 2)] if s < 40 else []
+    res = explore(1, trans, {"small": lambda s: None if s < 4 else f"s={s}"},
+                  depth=12)
+    [v] = res.violations
+    assert v.depth == 2 and v.trace == (("double",), ("double",))
+    assert "violated at depth 2" in v.render()
+
+
+def test_reports_are_byte_identical_across_runs():
+    a = check_protocol(DROPPED_TOMBSTONE)
+    b = check_protocol(DROPPED_TOMBSTONE)
+    assert a.verdict == b.verdict
+    assert a.format(costs=False) == b.format(costs=False)
+    assert ([v.render() for v in a.exploration.violations]
+            == [v.render() for v in b.exploration.violations])
+
+
+# ---- 2. the proof ---------------------------------------------------------
+
+def test_clean_model_proves_to_depth_8_fast():
+    """The acceptance bar: the 2-pool fleet model (1 crash + 1 handoff in
+    budget) proves every invariant to depth >= 8 on CPU in < 60s."""
+    assert CLEAN.depth >= 8
+    assert CLEAN.n_prefill >= 1 and CLEAN.n_decode >= 1
+    assert CLEAN.crash_budget >= 1 and CLEAN.handoff_budget >= 1
+    t0 = time.monotonic()
+    report = check_protocol(CLEAN)
+    assert time.monotonic() - t0 < 60
+    assert report.findings == []
+    assert report.ok(fail_on="warning")
+    assert report.verdict.startswith(f"proved to depth {CLEAN.depth}")
+    for inv in INVARIANTS:
+        assert inv in report.verdict
+
+
+# ---- 3. seeded defects --> exported counterexamples -----------------------
+
+@pytest.mark.parametrize("cfg,invariant", [
+    (DROPPED_TOMBSTONE, "double-serve"),
+    (LEGACY_ORDER, "lost-request"),
+    (SKIPPED_REFUND, "refcount"),
+    (UNGATED_BOARDING, "boarding-gate"),
+], ids=["dropped-tombstone", "legacy-order", "skipped-refund",
+        "ungated-boarding"])
+def test_defect_config_yields_counterexample(cfg, invariant):
+    report = check_protocol(cfg)
+    assert not report.ok(fail_on="error")
+    assert f"protocol.{invariant}" in {f.rule for f in report.findings}
+    v = next(v for v in report.exploration.violations
+             if v.invariant == invariant)
+    assert v.trace and v.depth == len(v.trace)
+    plan, note = export_fault_plan(v)
+    if plan is not None:
+        # every exported schedule must be installable as-is
+        parsed = faults.FaultPlan.parse(plan)
+        assert parsed.specs
+    else:
+        assert "model-only" in note or "no schedulable" in note or \
+            "no crash" in note
+
+
+def test_exported_drill_file_matches_model():
+    """The checked-in .chaos drill IS the model's export — regenerating it
+    from the dropped-tombstone counterexample reproduces the committed
+    schedule line byte-for-byte (drill_coverage counts this file, so it
+    must never drift from what the checker would emit)."""
+    report = check_protocol(DROPPED_TOMBSTONE)
+    v = next(v for v in report.exploration.violations
+             if v.invariant == "double-serve")
+    plan, _ = export_fault_plan(v)
+    committed = load_drill(
+        os.path.join(DRILLS, "dropped_handoff_double_serve.chaos"))
+    assert committed == plan
+
+
+def test_render_load_drill_round_trip(tmp_path):
+    report = check_protocol(DROPPED_TOMBSTONE)
+    v = report.exploration.violations[0]
+    text = render_drill(v, DROPPED_TOMBSTONE)
+    p = tmp_path / "x.chaos"
+    p.write_text(text)
+    plan, _ = export_fault_plan(v)
+    assert load_drill(str(p)) == plan
+    assert v.invariant in text and "model config:" in text
+
+
+def test_mid_handoff_crash_exports_handoff_site():
+    """A crash label carrying the mid-handoff marker maps to the
+    ``fleet.handoff`` injection site (the adopt/seal race), and the result
+    parses against the real faults grammar."""
+    v = Violation(invariant="double-serve", message="m",
+                  trace=(("handoff_begin", 0, 0), ("crash", 0, "mid-handoff")),
+                  depth=2)
+    plan, _ = export_fault_plan(v)
+    assert plan == "replica-kill@fleet.handoff,rank=0"
+    [spec] = faults.FaultPlan.parse(plan).specs
+    assert (spec.kind, spec.site, spec.rank) == \
+        ("replica-kill", "fleet.handoff", 0)
+
+
+def test_host_crash_counterexamples_are_model_only():
+    v = Violation(invariant="lost-request", message="m",
+                  trace=(("submit_journal", 0), ("crash_host",)), depth=2)
+    plan, note = export_fault_plan(v)
+    assert plan is None
+    assert "whole-host crash" in note        # the note explains WHY
+
+
+# ---- 4. abstract recovery vs the real fold (old-grammar regression) ------
+
+def _submit(rid, max_new):
+    return {"ev": "submit", "rid": rid, "prompt": [1, 2], "max_new": max_new,
+            "temp": 0.0, "top_k": None, "top_p": None, "eos": None,
+            "seed": 0, "cls": None, "prio": 0, "ttft_dl": None, "dl": None,
+            "t": 0.0}
+
+
+def _tok(rid, tok):
+    # deliberately tick-less and time-less: the OLD journal grammar
+    return {"ev": "tok", "rid": rid, "tok": tok, "kd": [0, 0, 0, 0]}
+
+
+def _snap(rid, state, toks, max_new):
+    # deliberately why-less: a pre-disaggregation snap record
+    ev = _submit(rid, max_new)
+    ev.update({"ev": "snap", "state": state, "reason": None,
+               "toks": toks, "kd": None, "ftt": None, "dt": None})
+    return ev
+
+
+OLD_GRAMMAR_JOURNAL = [
+    _submit(0, 4), _tok(0, 9), _tok(0, 9),
+    {"ev": "handoff", "rid": 0},                       # tombstoned: gone
+    _submit(1, 3), _tok(1, 5),                         # in flight, 1/3
+    _snap(2, "queued", [5, 6], 2),                     # not-acked: promotes
+    _submit(3, 4), _tok(3, 7),
+    {"ev": "done", "rid": 3, "reason": "eos"},         # acknowledged done
+    {"ev": "handoff", "rid": 4},
+    _snap(4, "queued", [8], 4),                        # adopted BACK: lives
+    _submit(5, 4),
+    {"ev": "shed", "rid": 5, "reason": "overload"},
+    {"ev": "restart", "n": 1},                         # observability-only
+]
+
+
+def test_abstract_recover_matches_recover_state_on_old_journals():
+    """The model's fold and the real fold agree rid-for-rid on a journal
+    written in the OLD grammar (no tick fields, no snap ``why``) — the
+    regression that pins the abstract model to what recovery actually
+    does, including the tombstone drop, the snap resurrection after a
+    handoff-back, and the journaled-but-not-acked DONE promotion."""
+    from simple_distributed_machine_learning_tpu.serve.journal import (
+        recover_state,
+    )
+    real = recover_state(OLD_GRAMMAR_JOURNAL)
+    model = abstract_recover(OLD_GRAMMAR_JOURNAL)
+    assert set(real) == set(model) == {1, 2, 3, 4, 5}   # 0 stays tombstoned
+    to_model = {"queued": "q", "active": "a", "done": "d", "shed": "s"}
+    for rid, r in real.items():
+        st, ntok = model[rid]
+        assert to_model[r.state] == st, f"rid {rid}"
+        assert len(r.tokens) == ntok, f"rid {rid}"
+    assert model[2] == ("d", 2)          # the not-acked promotion, both sides
+    assert model[4][0] == "q"            # resurrected after its tombstone
+
+
+# ---- 5. journal-grammar lint ---------------------------------------------
+
+def test_journal_grammar_clean_on_repo():
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        lint_journal_grammar,
+    )
+    assert lint_journal_grammar() == []
+
+
+def test_journal_grammar_flags_unread_event_kind(tmp_path):
+    """A writer emitting a kind no reader dispatches on is an ERROR; adding
+    a reader branch for the kind clears it. Keyed on the literal "ev"
+    field, so unrelated dict lookups never count as dispatch."""
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        lint_journal_grammar,
+    )
+    w = tmp_path / "writer.py"
+    w.write_text('def log_promote(j, rid):\n'
+                 '    j.append({"ev": "promote", "rid": rid})\n')
+    r = tmp_path / "reader.py"
+    r.write_text('def recover(evs):\n'
+                 '    for ev in evs:\n'
+                 '        kind = ev["ev"]\n'
+                 '        if kind == "submit":\n'
+                 '            pass\n'
+                 '        elif kind in ("tok", "done"):\n'
+                 '            pass\n')
+    findings = lint_journal_grammar([str(w)], [str(r)], repo=str(tmp_path))
+    from simple_distributed_machine_learning_tpu.analysis.report import (
+        Severity,
+    )
+    assert [f.rule for f in findings] == ["journal-grammar.unread-event"]
+    assert findings[0].severity is Severity.ERROR
+    assert "'promote'" in findings[0].message
+
+    r2 = tmp_path / "reader2.py"
+    r2.write_text('def report(evs):\n'
+                  '    return [e for e in evs if e.get("ev") == "promote"]\n')
+    assert lint_journal_grammar([str(w)], [str(r), str(r2)],
+                                repo=str(tmp_path)) == []
+
+
+def test_serve_protocol_cli_runs_without_jax():
+    """--serve-protocol is a pure-stdlib gate: it must run (and prove) with
+    jax purged and blocked, exactly like --hostlint — the CI lint job sets
+    no backend."""
+    prog = (
+        "import sys\n"
+        "for m in [k for k in sys.modules"
+        " if k == 'jax' or k.startswith(('jax.', 'jaxlib'))]:\n"
+        "    del sys.modules[m]\n"
+        "class B:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith(('jax.', 'jaxlib')):\n"
+        "            raise ImportError('blocked: ' + name)\n"
+        "sys.meta_path.insert(0, B())\n"
+        "try:\n"
+        "    import jax\n"
+        "except ImportError:\n"
+        "    pass\n"
+        "else:\n"
+        "    print('BLOCKER INERT'); sys.exit(3)\n"
+        "from simple_distributed_machine_learning_tpu.analysis.__main__ "
+        "import main\n"
+        "sys.exit(main(['--serve-protocol', '--depth', '6']))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", prog],
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "proved to depth 6" in proc.stdout
+
+
+# ---- 6. the fix, drilled on the real fleet -------------------------------
+
+def test_drill_coverage_learns_exported_chaos_drills():
+    """tests/data/protocol_drills/*.chaos is a coverage source, and the
+    new replica-kill@fleet.handoff pair (the adopt/seal race) is fired by
+    the committed drill — no gaps fleet-wide."""
+    assert faults.drill_coverage() == []
+
+
+def test_handoff_kill_drill_exactly_once_on_fixed_fleet(tmp_path):
+    """Satellite-1 pin: kill the handoff SOURCE between the destination's
+    adopt and the source's tombstone seal (the interleaving the old
+    tombstone-then-copy order turned into a lost request, and a missing
+    live-elsewhere guard turns into a double-serve). The shipped fleet
+    must stream the request exactly once."""
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models.gpt import (
+        GPTConfig,
+        make_gpt_stages,
+    )
+    from simple_distributed_machine_learning_tpu.serve import (
+        ServeFleet,
+        engine_factory,
+    )
+    from simple_distributed_machine_learning_tpu.serve.request import DONE
+
+    plan_text = load_drill(os.path.join(DRILLS, "handoff_kill.chaos"))
+    assert plan_text == "replica-kill@fleet.handoff,rank=0"
+
+    cfg = GPTConfig(vocab=32, seq_len=48, d_model=32, n_heads=2, n_layers=2)
+    stages = make_gpt_stages(jax.random.key(0), cfg, 2)[0]
+    prompt = np.asarray(
+        jax.random.randint(jax.random.key(3), (4,), 0, cfg.vocab), np.int32)
+    fleet = ServeFleet(
+        engine_factory(stages, cfg, n_slots=2, block_size=4,
+                       prefill_chunk=3),
+        os.path.join(str(tmp_path), "j"), n_replicas=3,
+        prefill_replicas=1, journal_sync=False)
+    got = []
+    h = fleet.submit(prompt, max_new_tokens=4, seed=3,
+                     on_token=lambda req, tok: got.append(tok))
+    faults.install(faults.FaultPlan.parse(plan_text))
+    for _ in range(80):
+        fleet.step()
+        if h.state == DONE:
+            break
+    faults.uninstall()
+    for _ in range(10):                      # settle: nothing replays after
+        fleet.step()
+    fleet.close()
+    assert h.state == DONE
+    assert got == list(h.tokens) and len(got) == 4   # exactly once
+    assert fleet.handoffs >= 1 and fleet.replica_losses == 1

@@ -96,9 +96,9 @@ def test_new_fault_kinds_parse_and_pair_strictly():
     with pytest.raises(ValueError, match="unknown fault site"):
         faults.FaultPlan.parse("nan-grad@train.grads=12")
     # crossed kind<->site pairs are refused at parse time
-    with pytest.raises(ValueError, match="only pairs with site"):
+    with pytest.raises(ValueError, match="only pairs with"):
         faults.FaultPlan.parse("nan-grad@train.step=12")
-    with pytest.raises(ValueError, match="only pairs with site"):
+    with pytest.raises(ValueError, match="only pairs with"):
         faults.FaultPlan.parse("loss-spike@data.batch=3")
     with pytest.raises(ValueError, match="only interprets"):
         faults.FaultPlan.parse("host-kill@train.grad=3")
