@@ -825,6 +825,13 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
         rows += _measure_host_offload(stages, cfg,
                                       n_requests=min(n_requests, 12),
                                       block_size=block_size)
+        # the ISSUE-19 row: what the always-on observability pipeline
+        # (SLO engine + trace + TTFT attribution) costs per tick
+        rows += _measure_slo_overhead(stages, cfg, slots=min(slots, 4),
+                                      n_requests=n_requests,
+                                      max_new=max_new,
+                                      prompt_lens=prompt_lens,
+                                      block_size=block_size)
     if default_shape:
         with open(os.path.join(REPO, "benchmarks", "serving.json"),
                   "w") as f:
@@ -1530,6 +1537,98 @@ def _measure_host_offload(stages, cfg, n_requests: int,
         "host_transfer_bytes": tier.get("host_transfer_bytes", 0),
         "wall_s": round(tier_wall, 3),
         "wall_s_hbm_only": round(base_wall, 3),
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }]
+
+
+def _measure_slo_overhead(stages, cfg, slots: int, n_requests: int,
+                          max_new: int, prompt_lens: tuple,
+                          block_size: int) -> list:
+    """Cost of the ISSUE-19 observability pipeline: the identical
+    supervised serve run with the SLO engine + request trace +
+    TTFT attribution ON vs OFF, reported as ticks/sec both ways.
+
+    The ON side binds an :class:`~telemetry.slo.SLOEngine` (windowed
+    quantile histograms + per-tick burn-rate alert evaluation) and an
+    in-memory :class:`~serve.tracing.ServeTrace`, then folds every
+    request through :func:`~telemetry.attribution.attribute` after the
+    drain — the full always-on production telemetry path.  The OFF side
+    is the bare supervisor.  Both sides share engine geometry (and so
+    the decode build cache and every compiled shape), and a warmup pass
+    runs first so neither measured side pays compile time."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.serve import (
+        ServeMetrics,
+        ServeSupervisor,
+        engine_factory,
+    )
+    from simple_distributed_machine_learning_tpu.serve.tracing import (
+        ServeTrace,
+    )
+    from simple_distributed_machine_learning_tpu.telemetry.attribution import (
+        attribute,
+    )
+    from simple_distributed_machine_learning_tpu.telemetry.slo import (
+        SLOEngine,
+        SLOObjective,
+    )
+
+    def run(with_slo: bool, n: int):
+        metrics = ServeMetrics()
+        slo = (SLOEngine([SLOObjective("bench", ttft_slo_ms=50.0,
+                                       tpot_slo_ms=20.0)],
+                         registry=metrics.registry) if with_slo else None)
+        trace = ServeTrace() if with_slo else None
+        tmpdir = tempfile.TemporaryDirectory(prefix="sdml-bench-slo-")
+        try:
+            sup = ServeSupervisor(
+                engine_factory(stages, cfg, n_slots=slots, kv_layout="paged",
+                               block_size=block_size,
+                               prefill_chunk=block_size, metrics=metrics),
+                os.path.join(tmpdir.name, "journal.jsonl"),
+                metrics=metrics, trace=trace, slo=slo)
+            rng = np.random.default_rng(0)
+            t0 = _time.perf_counter()
+            for i in range(n):
+                sup.submit(
+                    rng.integers(0, cfg.vocab,
+                                 prompt_lens[i % len(prompt_lens)]).astype(
+                                     np.int32),
+                    max_new_tokens=max_new, cls="bench")
+            sup.drain()
+            att = (attribute(trace.rows, registry=metrics.registry)
+                   if with_slo else None)
+            wall = _time.perf_counter() - t0
+            ticks = sup.tick
+            sup.close()
+        finally:
+            tmpdir.cleanup()
+        return ticks, wall, att, slo
+
+    run(False, min(n_requests, len(prompt_lens)))   # warmup: compile shapes
+    off_ticks, off_wall, _, _ = run(False, n_requests)
+    on_ticks, on_wall, att, slo = run(True, n_requests)
+    return [{
+        "config": "gpt_serve_slo_overhead",
+        "n_slots": slots, "n_requests": n_requests,
+        "max_new_tokens": max_new,
+        "ticks": on_ticks, "ticks_off": off_ticks,
+        "ticks_per_sec": round(on_ticks / on_wall, 1) if on_wall else None,
+        "ticks_per_sec_off": (round(off_ticks / off_wall, 1)
+                              if off_wall else None),
+        "wall_s": round(on_wall, 3), "wall_s_off": round(off_wall, 3),
+        "overhead_frac": (round(on_wall / off_wall - 1.0, 4)
+                          if off_wall else None),
+        "slo_evaluations": slo.evaluations,
+        "alert_transitions": len(slo.alerts.journal),
+        "attributed_requests": att["requests"],
+        "attribution_max_drift_ms": att["max_abs_drift_ms"],
         "device_kind": jax.devices()[0].device_kind,
         "backend": jax.default_backend(),
     }]

@@ -134,10 +134,26 @@ def main(argv=None) -> int:
     protocol_violated = False
 
     if args.hostlint:
+        import os as _os
+
         from simple_distributed_machine_learning_tpu.analysis.hostlint import (
             lint_repo,
         )
         report = lint_repo()
+        # the SDML_LINT_INJECT gate drill, mirrored inline (importing
+        # programs.py's helper would pull jax into this jax-free mode)
+        tag = _os.environ.get("SDML_LINT_INJECT")
+        if tag:
+            from simple_distributed_machine_learning_tpu.analysis.report import (  # noqa: E501
+                Finding,
+                Severity,
+            )
+            report.findings.append(Finding(
+                rule=f"injected.{tag}", severity=Severity.ERROR,
+                message="seeded ERROR finding injected via "
+                        "SDML_LINT_INJECT — the gate drill proving "
+                        "--lint preflights actually fail",
+                where="SDML_LINT_INJECT", hint="unset SDML_LINT_INJECT"))
         print(report.format(costs=False))
         host_ok = report.ok(args.fail_on or "error")
         print(f"analysis --hostlint: {'clean' if host_ok else 'FLAGGED'}")

@@ -26,6 +26,14 @@ catch with ``ast`` and expensive to catch in production:
   clock read goes through the injectable plumbing (``clock=`` default
   args, the simulator's VirtualClock) — referencing ``time.monotonic`` as
   a default is sanctioned, calling it inline is not;
+- ``metric-catalog.undocumented`` — a metric name registered in
+  ``serve/metrics.py`` or the telemetry SLO/attribution modules (any
+  full-string constant matching the ``serve_*``/``train_*`` metric
+  grammar) that ``telemetry/catalog.py`` cannot resolve to a HELP bullet:
+  an instrument with no documentation renders ``HELP <name> (undocumented)``
+  in the Prometheus exposition and tells an operator nothing. The catalog
+  module is loaded by file path (it imports only ast/os/re), so this rule
+  — like every other hostlint rule — runs without jax;
 - ``journal-grammar.unread-event`` — a journal event kind some writer in
   ``serve/`` emits (a dict display with a constant ``"ev"`` key) that NO
   reader dispatches on: neither ``serve/journal.py::recover_state`` (the
@@ -42,7 +50,9 @@ Pure ``ast`` — no jax import, so the CI lint job runs it in milliseconds:
 from __future__ import annotations
 
 import ast
+import importlib.util
 import os
+import re
 
 from simple_distributed_machine_learning_tpu.analysis.report import (
     Finding,
@@ -216,7 +226,14 @@ def lint_builder_definitions(gpt_path: str = GPT_PATH) -> list[Finding]:
 
 
 def _lint_call_sites(path: str, allow_jit: bool,
-                     repo: str = _REPO) -> list[Finding]:
+                     repo: str = _REPO,
+                     check_clock: bool | None = None) -> list[Finding]:
+    # historically the wall-clock rule rode on the serve/ (allow_jit)
+    # gate; check_clock decouples them so determinism-pinned modules
+    # OUTSIDE serve/ (the telemetry SLO pipeline) get clock-checked
+    # without inheriting the raw-jit rule
+    if check_clock is None:
+        check_clock = not allow_jit
     with open(path) as f:
         tree = ast.parse(f.read(), filename=path)
     findings: list[Finding] = []
@@ -247,13 +264,15 @@ def _lint_call_sites(path: str, allow_jit: bool,
                     where=_where(path, node, repo),
                     hint=f"call the public "
                          f"make{name[len('_build'):]} instead"))
-            if not allow_jit:
+            if check_clock:
                 clock = _wallclock_call(node, clock_bindings)
                 if clock is not None:
                     findings.append(Finding(
                         rule="hostlint.wall-clock-in-serve",
                         severity=Severity.ERROR,
-                        message=(f"'{clock}()' called inside serve/ — the "
+                        message=(f"'{clock}()' called inside a "
+                                 f"determinism-pinned module (serve/ and "
+                                 f"the telemetry SLO pipeline) — the "
                                  f"exact-pinned scenarios and journal "
                                  f"replay are deterministic ONLY because "
                                  f"every clock/RNG read goes through the "
@@ -383,6 +402,63 @@ def lint_journal_grammar(writer_paths=None, reader_paths=None,
     return findings
 
 
+#: modules whose full-string ``serve_*``/``train_*`` constants ARE metric
+#: names (verified by inspection — no span names or jsonl kinds match the
+#: grammar here); the catalog rule scans exactly these.
+_METRIC_FILES = (("serve", "metrics.py"), ("telemetry", "slo.py"),
+                 ("telemetry", "attribution.py"))
+_METRIC_NAME_RE = re.compile(r"^(serve|train)_[a-z0-9_]+$")
+
+
+def _metric_constants(path: str) -> list[tuple]:
+    """``(name, node)`` for every full-string constant in ``path`` that
+    matches the metric-name grammar."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    return [(node.value, node) for node in ast.walk(tree)
+            if isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _METRIC_NAME_RE.match(node.value)]
+
+
+def lint_metric_catalog(metric_files=None,
+                        repo: str = _REPO) -> list[Finding]:
+    """``metric-catalog.undocumented``: every metric name that appears in
+    the registering modules must resolve through
+    ``telemetry/catalog.py::metric_help`` (a HELP bullet in a catalog
+    docstring or an ``EXTRA_HELP`` entry). The catalog module is loaded by
+    FILE PATH — importing the ``telemetry`` package would pull in jax,
+    and the CI lint job (and ``test_hostlint_runs_without_jax``) run this
+    suite on a jax-free interpreter. ``metric_files`` parameterizes the
+    scanned modules for seeded-defect tests, mirroring
+    ``lint_journal_grammar``'s writer/reader path injection."""
+    pkg = os.path.join(repo, "simple_distributed_machine_learning_tpu")
+    catalog_path = os.path.join(pkg, "telemetry", "catalog.py")
+    spec = importlib.util.spec_from_file_location(
+        "_sdml_hostlint_catalog", catalog_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    help_map = mod.metric_help()
+    if metric_files is None:
+        metric_files = [os.path.join(pkg, *rel) for rel in _METRIC_FILES]
+    findings: list[Finding] = []
+    for path in metric_files:
+        for name, node in _metric_constants(path):
+            if name not in help_map:
+                findings.append(Finding(
+                    rule="metric-catalog.undocumented",
+                    severity=Severity.ERROR,
+                    message=(f"metric '{name}' is registered but "
+                             f"telemetry/catalog.py has no HELP text for "
+                             f"it — the Prometheus exposition renders "
+                             f"'(undocumented)' and operators fly blind"),
+                    where=_where(path, node, repo),
+                    hint="add a ``{name}`` — help bullet to the owning "
+                         "module's docstring (catalog.py parses the "
+                         "bullet grammar) or an EXTRA_HELP entry"))
+    return findings
+
+
 def lint_repo(repo: str = _REPO) -> Report:
     """The whole hostlint suite: builder definitions in models/gpt.py;
     cache-poke and builder-bypass EVERYWHERE outside the cache's owner —
@@ -396,7 +472,13 @@ def lint_repo(repo: str = _REPO) -> Report:
     gpt = os.path.abspath(os.path.join(pkg, "models", "gpt.py"))
     findings = lint_builder_definitions(gpt)
     findings.extend(lint_journal_grammar(repo=repo))
+    findings.extend(lint_metric_catalog(repo=repo))
     serve_dir = os.path.abspath(os.path.join(pkg, "serve")) + os.sep
+    # determinism-pinned modules outside serve/: the SLO/alert/attribution
+    # pipeline feeds exact-pinned scenario numbers, so it gets the same
+    # no-wall-clock rule (without serve/'s raw-jit rule)
+    clock_paths = {os.path.abspath(os.path.join(pkg, "telemetry", f))
+                   for f in ("slo.py", "alerts.py", "attribution.py")}
     paths: list[str] = []
     for d in (pkg, os.path.join(repo, "tests")):
         if not os.path.isdir(d):
@@ -412,5 +494,6 @@ def lint_repo(repo: str = _REPO) -> Report:
         if ap == gpt:
             continue
         findings.extend(_lint_call_sites(
-            path, allow_jit=not ap.startswith(serve_dir), repo=repo))
+            path, allow_jit=not ap.startswith(serve_dir), repo=repo,
+            check_clock=(ap.startswith(serve_dir) or ap in clock_paths)))
     return Report(name="hostlint", findings=findings)

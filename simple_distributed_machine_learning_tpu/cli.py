@@ -1302,6 +1302,26 @@ def _run_scenario(args, n_stages: int, key) -> None:
                          f"({'-' if a is None else round(a, 3)})")
         print(f"| scenario:   {cls} "
               f"[{'OK' if att['ok'] else 'VIOLATED'}] " + "; ".join(parts))
+    sa = report.get("slo_alerts")
+    if sa:
+        for tr in sa["transitions"]:
+            print(f"| scenario:   alert {tr['alert']} {tr['from']} -> "
+                  f"{tr['to']} @tick {tr['tick']} (burn fast/slow "
+                  f"{tr.get('burn_fast', 0)}/{tr.get('burn_slow', 0)})")
+        if not sa["transitions"]:
+            print("| scenario:   alerts: no burn-rate transitions "
+                  "(error budget never breached)")
+    att_blk = report.get("attribution")
+    if att_blk:
+        print(f"| scenario: attribution {att_blk['requests']} request(s) "
+              f"folded, {att_blk['recovered']} recovered, max drift "
+              f"{att_blk['max_abs_drift_ms']} ms")
+        for a in att_blk["top_slow"]:
+            comps = " ".join(f"{c}={v}"
+                             for c, v in a["components_ms"].items())
+            print(f"| scenario:   slow rid {a['rid']} ({a['cls']}) ttft "
+                  f"{a['ttft_ms']} vms: {comps}"
+                  + (" [recovered]" if a.get("recovered") else ""))
     if report.get("postmortem_bundles"):
         print(f"| scenario: {report['postmortem_bundles']} post-mortem "
               f"bundle(s) under {args.telemetry_dir}")

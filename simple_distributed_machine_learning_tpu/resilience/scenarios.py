@@ -540,6 +540,18 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
             InferenceEngine,
         )
         metrics = ServeMetrics(outdir=outdir, clock=clock)
+        # streaming SLO engine (ISSUE 19): built from the scenario's own
+        # per-class SLO targets whenever something owns a tick to drive
+        # it (supervisor or fleet; a bare engine run has no evaluator).
+        # Observation + evaluation never read a clock, so every
+        # pre-existing exact-pinned scenario number is unchanged.
+        slo_engine = None
+        if sup_flag or fleet_flag:
+            from simple_distributed_machine_learning_tpu.telemetry.slo import (  # noqa: E501
+                SLOEngine,
+            )
+            slo_engine = SLOEngine.from_classes(
+                scenario.sim.classes, registry=metrics.registry)
         if trace is True:
             from simple_distributed_machine_learning_tpu.serve.tracing import (  # noqa: E501
                 ServeTrace,
@@ -577,7 +589,7 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                 # durability (the supervised branch's sync rule)
                 journal_sync=not virtual,
                 journal_prefix=f"journal-{scenario.name}-r",
-                postmortem_dir=outdir)
+                postmortem_dir=outdir, slo=slo_engine)
         elif sup_flag:
             if outdir:
                 jpath = os.path.join(outdir,
@@ -605,7 +617,7 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                 # post-mortem bundle per restart / drain-timeout / shed
                 # burst next to the journal (no clock reads — the pinned
                 # numbers cannot move)
-                postmortem_dir=outdir)
+                postmortem_dir=outdir, slo=slo_engine)
         else:
             target = InferenceEngine(stages, cfg, **engine_kw)
         report = simulate(target, scenario.sim, sleep=sleep)
@@ -678,6 +690,14 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                >= scenario.min_host_prefetch_hits)
     if trace:
         report["trace_events"] = trace.n_events
+        # fold every traced request's timeline into the additive TTFT
+        # decomposition (components must reconcile with the journaled
+        # ttft_ms — attribute() raises on drift, a test failure)
+        from simple_distributed_machine_learning_tpu.telemetry.attribution import (  # noqa: E501
+            attribute,
+        )
+        report["attribution"] = attribute(trace.rows,
+                                          registry=metrics.registry)
     for tc in scenario.sim.classes:
         if tc.ttft_slo_ms is None and tc.tpot_slo_ms is None:
             continue
@@ -696,6 +716,8 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
     report["supervised"] = sup_flag
     report["slo"] = slo
     report["slo_ok"] = ok
+    if slo_engine is not None:
+        report["slo_alerts"] = slo_engine.summary()
     if plan is not None:
         report["faults"] = plan.stats()
     if outdir:
@@ -713,7 +735,21 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
             **({"fleet": {k: v for k, v in report["fleet"].items()
                           if k != "replica_log"}} if fleet_flag else {}),
             **({"host_tier": report["host_tier"]} if n_host else {}),
+            **({"slo_alerts": {
+                "transitions": len(slo_engine.alerts.journal),
+                "firing": slo_engine.active_alerts(),
+                "states": slo_engine.alerts.states()}}
+               if slo_engine is not None else {}),
+            **({"attribution": report["attribution"]}
+               if "attribution" in report else {}),
             **({"faults_fired": plan.stats()["total_fired"]}
                if plan is not None else {}),
         })
+        if slo_engine is not None:
+            # one joinable row per alert transition — what the CI chaos
+            # drill greps a fired-and-resolved pair out of
+            for tr in slo_engine.alerts.journal:
+                append_jsonl(os.path.join(outdir, "metrics.jsonl"),
+                             {"kind": "slo_alert",
+                              "scenario": scenario.name, **tr})
     return report

@@ -332,6 +332,9 @@ class InferenceEngine:
         self._prefilling: collections.deque[int] = collections.deque()
         # per-request last-emit timestamps for TPOT accounting
         self._last_emit: dict[int, float] = {}
+        # rids whose current prefetch-gate episode already traced a
+        # ``gate`` row (trace-only bookkeeping; cleared on boarding)
+        self._gated: set[int] = set()
 
     def _init_draft_pool(self, n_slots: int) -> None:
         """The draft model's K/V buffers: ALWAYS the dense slot layout
@@ -479,6 +482,19 @@ class InferenceEngine:
             # register before admission probes the prefix registry, so a
             # request blocked on its own prefetch boards this very tick
             self.pool.advance_transfers()
+            if self.trace is not None and getattr(self.pool, "_inflight",
+                                                  None):
+                # trace the upload gate: a queued request held back by its
+                # own in-flight prefetch gets ONE ``gate`` row per episode
+                # (attribution's queue-vs-prefetch split). Stamped with
+                # the most recent clock read, like paged admission — and
+                # only probed while uploads are actually in flight, so
+                # the common path pays one attribute test
+                for r in self.scheduler.queue:
+                    if (r.rid not in self._gated
+                            and self.pool.prefetch_blocked(r)):
+                        self._gated.add(r.rid)
+                        self.trace.on_gate(r, self._now)
             self._admit_paged()
             emitted = self._prefill_tick()
             decoding = self._decoding_slots()
@@ -737,6 +753,7 @@ class InferenceEngine:
         :meth:`_prefill_tick`."""
         for r in self.scheduler.admit():
             self._prefilling.append(r.rid)
+            self._gated.discard(r.rid)
             if self.trace is not None:
                 # boarding performs no clock read; stamped with the most
                 # recent one (at most a tick stale, see serve/tracing.py)

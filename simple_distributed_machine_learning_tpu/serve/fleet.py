@@ -128,6 +128,13 @@ class AutoscalePolicy:
     scale_out_ticks: int = 3
     retire_idle_s: float = 0.5
     kv_frac_high: float | None = None
+    #: optional SLO-burn scale-out trigger (ISSUE 19): when the fleet
+    #: runs with an SLO engine and ANY class burn rate (fast window)
+    #: reaches this threshold, the tick counts toward the same
+    #: ``scale_out_ticks`` backlog streak as queue depth — latency
+    #: pressure can add capacity before the queue-depth watermark trips.
+    #: None disables (the default: burn alerts only demote routing).
+    scale_out_burn_rate: float | None = None
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -147,6 +154,10 @@ class AutoscalePolicy:
         if self.kv_frac_high is not None and not 0 < self.kv_frac_high <= 1:
             raise ValueError(f"kv_frac_high must be in (0, 1], got "
                              f"{self.kv_frac_high}")
+        if (self.scale_out_burn_rate is not None
+                and self.scale_out_burn_rate <= 0):
+            raise ValueError(f"scale_out_burn_rate must be > 0, got "
+                             f"{self.scale_out_burn_rate}")
 
 
 @dataclasses.dataclass(eq=False)
@@ -204,7 +215,8 @@ class ServeFleet:
                  health_recover_ticks: int = 2,
                  journal_sync: bool = True,
                  journal_prefix: str = "journal-r",
-                 postmortem_dir: str | None = None) -> None:
+                 postmortem_dir: str | None = None,
+                 slo=None, alert_recover_ticks: int = 2) -> None:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if prefill_replicas and not 0 < prefill_replicas < n_replicas:
@@ -220,6 +232,9 @@ class ServeFleet:
         if health_recover_ticks < 1:
             raise ValueError(f"health_recover_ticks must be >= 1, got "
                              f"{health_recover_ticks}")
+        if alert_recover_ticks < 1:
+            raise ValueError(f"alert_recover_ticks must be >= 1, got "
+                             f"{alert_recover_ticks}")
         if autoscale is not None and not (autoscale.min_replicas
                                           <= n_replicas
                                           <= autoscale.max_replicas):
@@ -233,6 +248,17 @@ class ServeFleet:
         self.router = FleetRouter(route)
         self.autoscale = autoscale
         self.health_recover_ticks = int(health_recover_ticks)
+        # streaming SLO engine (telemetry/slo.py): the FLEET owns the one
+        # engine — replicas observe into it (replica-tagged via
+        # metrics._slo_replica), the fleet evaluates it once per fleet
+        # tick and converts firing per-replica burn alerts into routing
+        # demotions with their own re-entry hysteresis
+        self.slo = slo
+        self.alert_recover_ticks = int(alert_recover_ticks)
+        self._alert_demoted: set[int] = set()
+        self._alert_clear_streak: dict[int, int] = {}
+        if slo is not None and metrics is not None:
+            metrics.bind_slo(slo)
         self.journal_sync = journal_sync
         self._sup_kw = dict(
             max_restarts=max_restarts, degrade_after=degrade_after,
@@ -295,6 +321,11 @@ class ServeFleet:
             # stamp the pool role onto every flight-recorder row the
             # supervisor writes (serve/flight.py forensics join on it)
             sup.pool_role = role
+        if self.slo is not None:
+            # the replica's flight rows carry the active-alert set, but
+            # EVALUATION is fleet-owned: one engine, one tick domain
+            sup.slo = self.slo
+            sup._drive_slo = False
         rep = _Replica(idx=idx, supervisor=sup, journal_path=path,
                        role=role)
         self.replicas.append(rep)
@@ -372,9 +403,13 @@ class ServeFleet:
             candidates = self._role_candidates("prefill")
         else:
             candidates = self._rotation() or self._alive()
-        rep, hit = self.router.route(prompt, candidates)
-        if hit and self.metrics is not None:
-            self.metrics.on_affinity_hit()
+        rep, hit = self.router.route(prompt, candidates,
+                                     demoted=frozenset(self._alert_demoted))
+        if self.metrics is not None:
+            if hit:
+                self.metrics.on_affinity_hit()
+            if self.router.last_suppressed:
+                self.metrics.on_alert_demotion()
         # the router knows the prefix BEFORE admission: if a host-tier
         # copy of it beats what any target pool holds in HBM, start the
         # async upload NOW so it overlaps queueing + prefill instead of
@@ -385,6 +420,10 @@ class ServeFleet:
         rid = self._next_rid
         rep.supervisor.engine._next_rid = rid
         self._user_cb[rid] = on_token
+        if self.metrics is not None:
+            # admission sheds inside submit() observe into the SLO engine
+            # under this replica's index (reset in the finally below)
+            self.metrics._slo_replica = rep.idx
         try:
             h = rep.supervisor.submit(
                 prompt, max_new_tokens, temperature=temperature,
@@ -401,6 +440,9 @@ class ServeFleet:
             self._lose_replica(rep, f"RestartBudgetExceeded@submit: {e}")
             self._next_rid += 1
             return self.requests[rid]
+        finally:
+            if self.metrics is not None:
+                self.metrics._slo_replica = None
         self._next_rid += 1
         self.requests[h.rid] = h
         self._home[h.rid] = rep.idx
@@ -429,6 +471,10 @@ class ServeFleet:
                     break
         emitted = 0
         for rep in self._alive():
+            if self.metrics is not None:
+                # latency/shed observations inside this replica's tick
+                # land in the SLO engine under ITS index
+                self.metrics._slo_replica = rep.idx
             try:
                 emitted += rep.supervisor.step()
             except RestartBudgetExceeded as e:
@@ -436,7 +482,16 @@ class ServeFleet:
                 # replica: its in-flight work migrates, the fleet lives on
                 self._lose_replica(rep, f"RestartBudgetExceeded: {e}")
                 continue
+            finally:
+                if self.metrics is not None:
+                    self.metrics._slo_replica = None
             self._update_health(rep)
+        if self.slo is not None:
+            # fleet-owned evaluation: one engine over every replica's
+            # observations, stamped with the FLEET tick (replicas run with
+            # _drive_slo cleared), then alert -> routing-demotion feedback
+            self.slo.evaluate(self.tick)
+            self._update_alert_demotions()
         if self.disaggregated:
             self._handoff_step()
         if self.autoscale is not None:
@@ -512,7 +567,9 @@ class ServeFleet:
                 decode = self._role_candidates("decode")
                 cand = [r for r in decode if r is not src] or decode
                 h = sup.requests[rid]
-                dst, hit = self.router.route(h.prompt, cand)
+                dst, hit = self.router.route(
+                    h.prompt, cand,
+                    demoted=frozenset(self._alert_demoted))
                 if dst is src:
                     # degenerate fallback (every decode replica dead and
                     # the source is the only survivor): nothing to move to
@@ -610,6 +667,31 @@ class ServeFleet:
                 self._log_event("re-enter", rep)
         self._now = max(self._now, sup.engine._now)
 
+    def _update_alert_demotions(self) -> None:
+        """Alert → router feedback (ISSUE 19): a replica whose
+        per-replica burn alert (``slo_burn{replica=i}``) is firing loses
+        the router's affinity preference and sorts last in the
+        least-loaded fallback — still serving (demotion never empties the
+        candidate list), just not *attracting* the hot traffic that dug
+        the latency hole. Re-entry mirrors ``_update_health``'s
+        hysteresis: ``alert_recover_ticks`` consecutive non-firing fleet
+        ticks AFTER the alert resolves (which itself took the state
+        machine's ``resolve_ticks``), so a flapping alert cannot bounce a
+        replica in and out of preference every tick."""
+        firing = self.slo.firing_replicas()
+        for rep in self._alive():
+            if rep.idx in firing:
+                if rep.idx not in self._alert_demoted:
+                    self._alert_demoted.add(rep.idx)
+                    self._log_event("alert-demote", rep)
+                self._alert_clear_streak[rep.idx] = 0
+            elif rep.idx in self._alert_demoted:
+                streak = self._alert_clear_streak.get(rep.idx, 0) + 1
+                self._alert_clear_streak[rep.idx] = streak
+                if streak >= self.alert_recover_ticks:
+                    self._alert_demoted.discard(rep.idx)
+                    self._log_event("alert-re-enter", rep)
+
     # -- replica loss + migration -------------------------------------------
 
     def _lose_replica(self, rep: _Replica, cause: str) -> None:
@@ -679,7 +761,8 @@ class ServeFleet:
                         or targets)
             else:
                 cand = [r for r in targets if r.in_rotation] or targets
-            dst, hit = self.router.route(h.prompt, cand)
+            dst, hit = self.router.route(
+                h.prompt, cand, demoted=frozenset(self._alert_demoted))
             if hit and self.metrics is not None:
                 self.metrics.on_affinity_hit()
             if self.trace is not None:
@@ -720,7 +803,13 @@ class ServeFleet:
                     use += s["blocks_in_use"]
                     tot += s["blocks_total"]
             kv_high = tot > 0 and use / tot >= pol.kv_frac_high
-        if qd >= pol.scale_out_queue_depth or kv_high:
+        burn_high = False
+        if pol.scale_out_burn_rate is not None and self.slo is not None:
+            # latency pressure as a scale-out signal: any class burning
+            # its error budget at >= the threshold counts like backlog
+            burn_high = (max(self.slo.burn_rates().values(), default=0.0)
+                         >= pol.scale_out_burn_rate)
+        if qd >= pol.scale_out_queue_depth or kv_high or burn_high:
             self._backlog_ticks += 1
         else:
             self._backlog_ticks = 0

@@ -6,10 +6,10 @@ The serving-standard latency split, as registry instruments:
   per request. The latency a user perceives before anything streams.
 - ``serve_tpot_ms`` (histogram) — time per output token after the first:
   the decode-tick cadence, one observation per generated token.
-- ``serve_queue_depth`` / ``serve_slots_active`` (gauges) and
-  ``serve_slot_occupancy`` (histogram of active/total per tick) — how full
-  the continuous batch runs; occupancy is what batched decoding converts
-  into aggregate throughput.
+- ``serve_queue_depth`` / ``serve_slots_active`` / ``serve_slots_total``
+  (gauges) and ``serve_slot_occupancy`` (histogram of active/total per
+  tick) — how full the continuous batch runs; occupancy is what batched
+  decoding converts into aggregate throughput.
 - ``serve_requests_submitted_total`` / ``serve_requests_completed_total`` /
   ``serve_tokens_generated_total`` (counters) and ``serve_tokens_per_sec``
   (gauge) — lifetime request/token counters and aggregate throughput over
@@ -38,10 +38,11 @@ Sharded + speculative instruments (ISSUE 9):
   anchor), 1 = the fused Pallas paged-attention kernel (one HBM pass of
   resident K/V per tick; ``ops/paged_attention.py``) — dashboards
   correlate per-tick latency shifts with the kernel path in play;
-- ``serve_spec_proposed_tokens_total`` / ``..accepted..`` / ``..rejected..``
-  (counters) and ``serve_spec_accept_rate`` (histogram, one observation
-  per speculative tick) — how much of the draft's work the target agreed
-  with; accept rate is what converts ``spec_k`` into real tokens/tick.
+- ``serve_spec_proposed_tokens_total`` / ``serve_spec_accepted_tokens_total``
+  / ``serve_spec_rejected_tokens_total`` (counters) and
+  ``serve_spec_accept_rate`` (histogram, one observation per speculative
+  tick) — how much of the draft's work the target agreed with; accept
+  rate is what converts ``spec_k`` into real tokens/tick.
 
 Traffic-class instruments (populated when requests carry ``cls`` — the
 scenario suite's per-class SLO accounting, ``resilience/scenarios.py``):
@@ -59,8 +60,9 @@ Crash-restart + overload-control instruments (fed by the serve supervisor,
 ``serve/supervisor.py``):
 
 - ``serve_restarts_total`` (counter) — engine rebuilds after a recoverable
-  failure; ``serve_recovered_requests_total`` (counter) — in-flight
-  requests re-admitted from the journal across those restarts;
+  failure;
+- ``serve_recovered_requests_total`` (counter) — in-flight requests
+  re-admitted from the journal across those restarts;
 - ``serve_shed_total{reason=deadline|backpressure|class}`` (counter) and
   ``serve_class_shed_total{class=...}`` — structured rejections: expired
   deadlines, queue-depth backpressure, per-class token-bucket/degraded
@@ -89,7 +91,13 @@ Fleet instruments (fed by the multi-replica fleet, ``serve/fleet.py``):
   hot-prefix-skew scenario pins this strictly above round-robin);
 - ``serve_fleet_scale_outs_total`` / ``serve_fleet_retired_total``
   (counters) — autoscaler actions: replicas added on sustained backlog,
-  replicas drained-then-retired on sustained idleness.
+  replicas drained-then-retired on sustained idleness;
+- ``serve_route_alert_demotions_total`` (counter) — routing decisions
+  where the best prefix-affinity candidate was skipped because its
+  per-replica SLO burn alert was firing (the alert→router feedback loop;
+  the burn-rate / alert instruments themselves are documented alongside
+  the SLO engine, ``telemetry/slo.py``, and the TTFT attribution
+  histogram alongside ``telemetry/attribution.py``).
 
 Disaggregated-pool + host-offload-tier instruments (ISSUE 17 — fed by
 the disaggregated fleet, ``serve/fleet.py``, and the paged pool's host
@@ -243,7 +251,15 @@ class ServeMetrics:
         self.fleet_scale_outs = r.counter("serve_fleet_scale_outs_total")
         self.fleet_retired = r.counter("serve_fleet_retired_total")
         self.fleet_handoffs = r.counter("serve_fleet_handoffs_total")
+        self.route_alert_demotions = r.counter(
+            "serve_route_alert_demotions_total")
         self._fleet_seen = False
+        # optional streaming SLO engine (telemetry/slo.py): when bound,
+        # every TTFT/TPOT/shed observation is forwarded with the replica
+        # index the fleet sets around each per-replica step/submit (None
+        # under a single supervisor — class-level series only)
+        self.slo = None
+        self._slo_replica: int | None = None
         # disaggregated per-pool gauges (labeled by role; fed by the fleet
         # once per tick when it runs with prefill_replicas > 0)
         self._pool_gauges: dict[tuple, object] = {}
@@ -280,16 +296,27 @@ class ServeMetrics:
             self._t_first_submit = self._clock()
         self.submitted.inc()
 
+    def bind_slo(self, slo) -> None:
+        """Attach a :class:`telemetry.slo.SLOEngine`; subsequent latency
+        and shed observations stream into its windowed series."""
+        self.slo = slo
+
     def on_first_token(self, ttft_s: float, cls: str | None = None) -> None:
         self.ttft_ms.observe(ttft_s * 1e3)
         if cls is not None:
             self._class_hist("serve_class_ttft_ms", cls).observe(ttft_s * 1e3)
+            if self.slo is not None:
+                self.slo.observe_ttft(cls, ttft_s * 1e3,
+                                      replica=self._slo_replica)
         self._on_any_token()
 
     def on_token(self, tpot_s: float, cls: str | None = None) -> None:
         self.tpot_ms.observe(tpot_s * 1e3)
         if cls is not None:
             self._class_hist("serve_class_tpot_ms", cls).observe(tpot_s * 1e3)
+            if self.slo is not None:
+                self.slo.observe_tpot(cls, tpot_s * 1e3,
+                                      replica=self._slo_replica)
         self._on_any_token()
 
     def on_preempt(self, cls: str | None = None) -> None:
@@ -320,6 +347,8 @@ class ServeMetrics:
         counter.inc()
         if cls is not None:
             self._class_counter("serve_class_shed_total", cls).inc()
+            if self.slo is not None:
+                self.slo.observe_shed(cls, replica=self._slo_replica)
 
     def set_degraded(self, degraded) -> None:
         self._resilience_seen = True
@@ -349,6 +378,12 @@ class ServeMetrics:
     def on_affinity_hit(self) -> None:
         self._fleet_seen = True
         self.route_affinity_hits.inc()
+
+    def on_alert_demotion(self) -> None:
+        """The router skipped the best affinity candidate because its
+        per-replica burn alert was firing (the alert feedback loop)."""
+        self._fleet_seen = True
+        self.route_alert_demotions.inc()
 
     def on_scale_out(self) -> None:
         self._fleet_seen = True
@@ -570,6 +605,8 @@ class ServeMetrics:
                 "fleet_scale_outs": int(self.fleet_scale_outs.value),
                 "fleet_retired": int(self.fleet_retired.value),
                 "fleet_handoffs": int(self.fleet_handoffs.value),
+                "route_alert_demotions": int(
+                    self.route_alert_demotions.value),
             })
         if self._pools_seen:
             out["pools"] = {

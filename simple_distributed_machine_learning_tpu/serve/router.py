@@ -33,6 +33,17 @@ are in rotation (supervisor state machine + re-entry hysteresis,
 ``serve/fleet.py``) and hands the candidate list in. An empty candidate
 list is the caller's bug — the fleet always routes over at least one
 alive replica (spawning one if the last died).
+
+Alert demotion (ISSUE 19) follows the same division of labour: the fleet
+passes the set of replica indices whose per-replica SLO burn alert is
+firing (``demoted``), and the router treats them as *last-resort*
+capacity — ineligible for the affinity preference (sending more hot
+traffic at a replica already burning its latency budget digs the hole
+deeper) and ordered after every non-demoted replica in the least-loaded
+fallback. When the demotion actually changed the answer — the best
+affinity candidate over ALL candidates was demoted and skipped — the
+router records it on :attr:`last_suppressed` for the fleet's
+``serve_route_alert_demotions_total`` counter.
 """
 
 from __future__ import annotations
@@ -58,6 +69,9 @@ class FleetRouter:
                 f"unknown route policy {policy!r}; known: {POLICIES}")
         self.policy = policy
         self._rr = 0          # round-robin cursor (monotonic, mod applied)
+        #: last route() skipped the best affinity candidate because it was
+        #: demoted — the fleet reads this to count alert demotions
+        self.last_suppressed = False
 
     @staticmethod
     def _load_key(rep) -> tuple:
@@ -71,13 +85,17 @@ class FleetRouter:
                 pool.n_active / pool.n_slots,
                 rep.idx)
 
-    def route(self, prompt, candidates: list) -> tuple:
+    def route(self, prompt, candidates: list,
+              demoted: frozenset = frozenset()) -> tuple:
         """Pick the replica for ``prompt`` from ``candidates`` (the
-        fleet's in-rotation list, index order, non-empty)."""
+        fleet's in-rotation list, index order, non-empty). ``demoted``
+        holds replica indices whose burn alert is firing — still legal
+        targets (capacity is capacity), but never *preferred*."""
         if not candidates:
             raise ValueError("route over an empty candidate list — the "
                              "fleet must always offer at least one "
                              "alive replica")
+        self.last_suppressed = False
         if self.policy == "round-robin":
             rep = candidates[self._rr % len(candidates)]
             self._rr += 1
@@ -85,6 +103,7 @@ class FleetRouter:
         if self.policy == "affinity":
             prompt = np.asarray(prompt, np.int32)
             best, best_len = None, 0
+            skipped_len = 0   # longest prefix held by a DEMOTED replica
             for rep in candidates:
                 pool = rep.supervisor.pool
                 # HBM-registered prefix OR host-tier-resident prefix: a
@@ -94,10 +113,18 @@ class FleetRouter:
                 # a host tier answer 0, so the signal is unchanged there.
                 n = max(pool.shared_prefix_len(prompt),
                         pool.host_prefix_len(prompt))
-                if n > best_len:
+                if rep.idx in demoted:
+                    skipped_len = max(skipped_len, n)
+                elif n > best_len:
                     best, best_len = rep, n
+            if skipped_len > best_len:
+                # the demotion changed the routing answer: the longest
+                # prefix lives on a firing replica and we went elsewhere
+                self.last_suppressed = True
             if best is not None:
                 return best, True
         # least-loaded: the standalone policy AND the affinity cold-start
-        # fallback
-        return min(candidates, key=self._load_key), False
+        # fallback; demoted replicas sort after every healthy one
+        return min(candidates,
+                   key=lambda rep: (rep.idx in demoted,
+                                    *self._load_key(rep))), False

@@ -214,7 +214,7 @@ class ServeSupervisor:
                  default_deadline_s: float | None = None,
                  trace=None, flight=None, postmortem_dir: str | None = None,
                  postmortem_tail: int = 64, shed_burst: int = 4,
-                 postmortem_tag: str = "") -> None:
+                 postmortem_tag: str = "", slo=None) -> None:
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, got "
                              f"{max_restarts}")
@@ -253,6 +253,15 @@ class ServeSupervisor:
             )
             flight = FlightRecorder()
         self.flight = flight
+        # streaming SLO engine (telemetry/slo.py): evaluated once per
+        # supervised tick AT self.tick (never from a clock), so alert
+        # transitions are exact-pinnable under the virtual clock. Under a
+        # fleet the FLEET owns evaluation (one engine across replicas,
+        # evaluated at fleet.tick) and clears _drive_slo on every replica.
+        self.slo = slo
+        self._drive_slo = True
+        if slo is not None and metrics is not None:
+            metrics.bind_slo(slo)
         self.postmortems: list[str] = []     # bundle paths, write order
         self._sheds_since_step = 0
         # disaggregated-fleet role ("prefill" | "decode"; None outside a
@@ -379,13 +388,21 @@ class ServeSupervisor:
         #                                even if no further arrival probes it
         if self.metrics is not None:
             self.metrics.set_journal_bytes(self.journal.bytes)
+        if self.slo is not None and self._drive_slo:
+            # evaluate BEFORE the flight snapshot so the row at tick T
+            # carries the alert set as of the evaluation at T (the
+            # bundle/journal tick-join contract)
+            self.slo.evaluate(self.tick)
         if self.flight is not None:
             self.flight.snap(self.engine, self.tick, emitted,
                              state=self.state, restarts=self.restarts,
                              degraded=self.degraded,
                              load_degraded=self.load_degraded,
                              **({} if self.pool_role is None
-                                else {"pool_role": self.pool_role}))
+                                else {"pool_role": self.pool_role}),
+                             **({} if self.slo is None
+                                else {"active_alerts":
+                                      self.slo.active_alerts()}))
         if self._sheds_since_step >= self.shed_burst:
             self._dump_postmortem(
                 "shed_burst", f"{self._sheds_since_step} sheds in one tick")
@@ -441,7 +458,9 @@ class ServeSupervisor:
                       if self.metrics is not None else None),
             journal_tail=self.journal.tail(self.postmortem_tail),
             restarts=self.restarts, degraded=self.degraded,
-            state=self.state)
+            state=self.state,
+            **({} if self.slo is None
+               else {"active_alerts": self.slo.active_alerts()}))
         self.postmortems.append(path)
         return path
 

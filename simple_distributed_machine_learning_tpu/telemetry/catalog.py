@@ -25,8 +25,11 @@ import ast
 import os
 import re
 
-# serve/metrics.py documents every serve_* instrument; parsed lazily once.
-_DOC_FILES = (("serve", "metrics.py"),)
+# serve/metrics.py documents every serve_* instrument; the SLO engine and
+# the attribution module document their own instruments in their module
+# docstrings (same bullet grammar). Parsed lazily once.
+_DOC_FILES = (("serve", "metrics.py"), ("telemetry", "slo.py"),
+              ("telemetry", "attribution.py"))
 
 #: metrics documented in prose (trainer / session / bench paths) rather
 #: than catalog bullets — the explicit side of the catalog.

@@ -4,7 +4,10 @@
 --dir DIR`` renders everything a run left behind — ``metrics.jsonl``
 (serve / scenario / epoch records), the request journal(s), the
 request-scoped trace timeline(s) and any post-mortem bundles — as one
-summary: per-class SLO attainment, the shed breakdown, the restart
+summary: per-class SLO attainment, the shed breakdown, the SLO alert
+transition log (``kind: "slo_alert"`` records — the burn-rate state
+machine's journal) and the per-scenario TTFT attribution block with its
+top-K slow-request autopsy table, the restart
 timeline (journal ``restart`` events with their monotonic ticks), TTFT /
 TPOT quantiles, KV-drift, the disaggregated-pool block (per-role replica/
 queue/slot gauges plus the host offload tier's demote/promote/prefetch
@@ -67,6 +70,12 @@ def collect(outdir: str) -> dict:
     serve = [r for r in metrics if r.get("kind") == "serve"]
     scenarios = [r for r in metrics if r.get("kind") == "scenario"]
     epochs = [r for r in metrics if r.get("kind") == "epoch"]
+    # SLO alert transitions (one joinable row each, telemetry/alerts.py)
+    # and the per-scenario TTFT attribution blocks (telemetry/
+    # attribution.py) — both land in metrics.jsonl via run_scenario
+    slo_alerts = [r for r in metrics if r.get("kind") == "slo_alert"]
+    attribution = {r.get("scenario"): r["attribution"]
+                   for r in scenarios if r.get("attribution")}
 
     journals = {}
     for path in sorted(glob.glob(os.path.join(outdir, "journal*.jsonl"))):
@@ -191,6 +200,8 @@ def collect(outdir: str) -> dict:
         "dir": outdir,
         "serve": serve[-1] if serve else None,
         "scenarios": scenarios,
+        "slo_alerts": slo_alerts,
+        "attribution": attribution,
         "epochs": len(epochs),
         "last_epoch": epochs[-1] if epochs else None,
         "sentinel": sentinel,
@@ -298,6 +309,37 @@ def render(report: dict) -> str:
                      if k in att]
             lines.append(f"    {cls}: attainment {', '.join(gates)} "
                          f"[{'ok' if att.get('ok') else 'MISS'}]")
+    for rec in report.get("slo_alerts") or []:
+        lines.append(
+            f"  alert {rec.get('alert')}: {rec.get('from')} -> "
+            f"{rec.get('to')} @tick {rec.get('tick')} (burn fast/slow "
+            f"{_fmt(rec.get('burn_fast'))}/{_fmt(rec.get('burn_slow'))})"
+            + (f" [{rec['scenario']}]" if rec.get("scenario") else ""))
+    for scen_name, att in sorted((report.get("attribution") or {}).items()):
+        lines.append(
+            f"  attribution [{scen_name}]: {att.get('requests', 0)} "
+            f"request(s) folded, {att.get('recovered', 0)} recovered, "
+            f"max drift {_fmt(att.get('max_abs_drift_ms'), 6)} ms")
+        for cls, blk in sorted((att.get("by_class") or {}).items()):
+            comps = ", ".join(
+                f"{c} {_fmt(v)}" for c, v in
+                (blk.get("components_ms_mean") or {}).items())
+            lines.append(
+                f"    class {cls}: mean ttft "
+                f"{_fmt(blk.get('ttft_ms_mean'))} ms = {comps}")
+        top = att.get("top_slow") or []
+        if top:
+            lines.append("    top slow requests (TTFT autopsy):")
+            lines.append(f"      {'rid':>5}  {'class':<12} "
+                         f"{'ttft_ms':>9}  components")
+            for a in top:
+                comps = " ".join(
+                    f"{c}={_fmt(v)}" for c, v in
+                    (a.get("components_ms") or {}).items())
+                lines.append(
+                    f"      {a.get('rid'):>5}  {str(a.get('cls')):<12} "
+                    f"{_fmt(a.get('ttft_ms')):>9}  {comps}"
+                    + (" [recovered]" if a.get("recovered") else ""))
     for name, j in report["journals"].items():
         lines.append(f"  journal {name}: {j['events']} events "
                      f"{j['by_kind']}")

@@ -885,3 +885,83 @@ def test_fault_drill_coverage_clean_and_detects_gaps(tmp_path):
                           sites=("serve.tick",), pairs=())
     assert gaps == []
     assert "slow-tick" in KINDS and "serve.tick" in SITES
+
+
+# ---- satellite: metric-catalog coverage lint (ISSUE 19) -----------------
+
+
+def test_metric_catalog_rule_repo_clean():
+    """Every metric constant registered in serve/metrics.py,
+    telemetry/slo.py and telemetry/attribution.py resolves to HELP text —
+    the repo's own catalog has no undocumented instrument."""
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        lint_metric_catalog,
+    )
+    assert lint_metric_catalog() == []
+
+
+def test_metric_catalog_rule_flags_undocumented(tmp_path):
+    """The seeded defect: a registering module with a metric name the
+    catalog has never heard of must ERROR (path injection mirrors the
+    journal-grammar lint's writer/reader seeding)."""
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        Severity,
+        lint_metric_catalog,
+    )
+    bad = tmp_path / "metrics_like.py"
+    bad.write_text(
+        'DOCUMENTED = "serve_blocks_in_use"\n'
+        'UNDOCUMENTED = "serve_bogus_flux_capacitor_total"\n'
+        'NOT_A_METRIC = "some random string"\n')
+    findings = lint_metric_catalog(metric_files=[str(bad)])
+    assert [f.rule for f in findings] == ["metric-catalog.undocumented"]
+    assert findings[0].severity is Severity.ERROR
+    assert "serve_bogus_flux_capacitor_total" in findings[0].message
+
+
+def test_metric_catalog_covers_slo_and_attribution_instruments():
+    """The new ISSUE-19 instruments resolve through the catalog (their
+    HELP bullets live in their own modules' docstrings)."""
+    from simple_distributed_machine_learning_tpu.telemetry.catalog import (
+        metric_help,
+    )
+    helps = metric_help()
+    for name in ("serve_slo_burn_rate", "serve_alerts_firing",
+                 "serve_ttft_component_ms",
+                 "serve_route_alert_demotions_total"):
+        assert name in helps, name
+
+
+def test_hostlint_wall_clock_rule_covers_slo_pipeline(tmp_path):
+    """The zero-wall-clock-reads pin, hostlint-enforced: the clock rule
+    now runs over telemetry/{slo,alerts,attribution}.py exactly as over
+    serve/ (check_clock decouples it from the jit gate), and a seeded
+    clock read in an SLO-pipeline-like module is flagged."""
+    from simple_distributed_machine_learning_tpu.analysis.hostlint import (
+        _lint_call_sites,
+    )
+    bad = tmp_path / "slo_like.py"
+    bad.write_text(
+        "import time\n"
+        "def evaluate(tick):\n"
+        "    return time.monotonic()\n")
+    # telemetry modules lint with the clock rule ON but raw-jit OFF
+    flagged = [f.rule for f in _lint_call_sites(str(bad), allow_jit=True,
+                                                check_clock=True)]
+    assert flagged == ["hostlint.wall-clock-in-serve"]
+    assert not _lint_call_sites(str(bad), allow_jit=True)
+
+
+def test_hostlint_cli_inject_drill(monkeypatch, capsys):
+    """SDML_LINT_INJECT trips the --hostlint gate: the negative test
+    proving the CI lint job's preflight actually fails on an ERROR."""
+    from simple_distributed_machine_learning_tpu.analysis.__main__ import (
+        main,
+    )
+    monkeypatch.setenv("SDML_LINT_INJECT", "drill")
+    assert main(["--hostlint"]) == 1
+    out = capsys.readouterr().out
+    assert "injected.drill" in out and "FLAGGED" in out
+    monkeypatch.delenv("SDML_LINT_INJECT")
+    assert main(["--hostlint"]) == 0
+    capsys.readouterr()

@@ -476,3 +476,32 @@ def test_report_cli_exit_codes(tmp_path, capsys):
     empty.mkdir()
     assert report.main(["--dir", str(empty)]) == 2
     capsys.readouterr()
+
+
+def test_report_json_schema_pinned(tmp_path, capsys):
+    """The ``--json`` document's top-level keys are an interface other
+    tooling parses — pinned EXACTLY (a new artifact must land here, and
+    the ISSUE-19 ``slo_alerts``/``attribution`` blocks are always
+    present, never conditionally spliced in)."""
+    from simple_distributed_machine_learning_tpu.telemetry import report
+
+    stages = _model()
+    d = str(tmp_path / "run")
+    run_scenario("overload-shed", stages, CFG, outdir=d, trace=True)
+    assert report.main(["--dir", d, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {
+        "dir", "serve", "scenarios", "slo_alerts", "attribution",
+        "epochs", "last_epoch", "sentinel", "journals", "timelines",
+        "traces", "postmortems"}
+    assert [(r["tick"], r["to"]) for r in doc["slo_alerts"]] == [
+        (37, "pending"), (38, "firing"), (49, "resolved"),
+        (50, "inactive")]
+    att = doc["attribution"]["overload-shed"]
+    assert att["requests"] == 11 and att["top_slow"][0]["rid"] == 2
+    # the text renderer shows the same two blocks: alert transitions and
+    # the top-K slow-request autopsy table
+    assert report.main(["--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "alert slo_burn{class=interactive}: pending -> firing" in out
+    assert "top slow requests (TTFT autopsy):" in out
