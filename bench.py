@@ -825,6 +825,13 @@ def measure_serving(rates: tuple = (2.0, 8.0, 32.0), n_requests: int = 24,
         rows += _measure_host_offload(stages, cfg,
                                       n_requests=min(n_requests, 12),
                                       block_size=block_size)
+        # the ISSUE-20 row: N LoRA tenants batched through one engine's
+        # adapter bank vs N sequential dedicated merged-dense engines
+        rows += _measure_multi_adapter(stages, cfg, slots=min(slots, 4),
+                                       n_requests=min(n_requests, 12),
+                                       max_new=max_new,
+                                       prompt_lens=prompt_lens,
+                                       block_size=block_size)
         # the ISSUE-19 row: what the always-on observability pipeline
         # (SLO engine + trace + TTFT attribution) costs per tick
         rows += _measure_slo_overhead(stages, cfg, slots=min(slots, 4),
@@ -1537,6 +1544,112 @@ def _measure_host_offload(stages, cfg, n_requests: int,
         "host_transfer_bytes": tier.get("host_transfer_bytes", 0),
         "wall_s": round(tier_wall, 3),
         "wall_s_hbm_only": round(base_wall, 3),
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }]
+
+
+def _measure_multi_adapter(stages, cfg, slots: int, n_requests: int,
+                           max_new: int, prompt_lens: tuple,
+                           block_size: int, n_adapters: int = 3,
+                           rank: int = 4) -> list:
+    """Multi-tenant LoRA serving's consolidation claim (ISSUE 20),
+    measured head to head: N tenants through ONE engine — shared base
+    weights plus a gathered adapter bank, every tick batching whatever
+    tenant mix is resident — vs the dedicated deployment, N engines each
+    serving its tenant's merged ``W + A @ B`` weights one after the
+    other. Same prompts, same decode lengths, same total request count.
+    The row reports tokens/sec both ways and the memory story: the
+    bank's resident bytes vs the ``N - 1`` extra full parameter copies
+    the dedicated deployment pays (the adapter path keeps ONE base
+    copy)."""
+    import dataclasses as _dc
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from simple_distributed_machine_learning_tpu.models import lora
+    from simple_distributed_machine_learning_tpu.serve import (
+        InferenceEngine,
+    )
+    from simple_distributed_machine_learning_tpu.serve.adapters import (
+        AdapterStore,
+    )
+
+    rng = np.random.default_rng(11)
+    names = [f"tenant-{k}" for k in range(n_adapters)]
+    adapters = {name: lora.init_lora_adapter(jax.random.key(100 + k),
+                                             cfg, rank)
+                for k, name in enumerate(names)}
+    prompts = [rng.integers(0, cfg.vocab,
+                            prompt_lens[i % len(prompt_lens)])
+               .astype(np.int32) for i in range(n_requests)]
+    tenant_of = [names[i % n_adapters] for i in range(n_requests)]
+    params_list = [s.params for s in stages]
+    base_bytes = int(sum(x.nbytes for x in jax.tree.leaves(params_list)))
+
+    def _warm(engine, adapter=None):
+        # compile every shape outside the timed window (both sides pay
+        # their tracing up front, so the row measures steady-state ticks)
+        for t0 in sorted(set(len(p) for p in prompts)):
+            engine.submit(rng.integers(0, cfg.vocab, t0).astype(np.int32),
+                          max_new_tokens=2, adapter=adapter)
+        engine.drain()
+
+    # -- one engine, N tenants batched through the adapter bank ----------
+    store = AdapterStore(cfg, rank, slots)
+    for name in names:
+        store.register(name, adapters[name])
+    multi = InferenceEngine(stages, cfg, n_slots=slots,
+                            block_size=block_size, adapters=store)
+    _warm(multi, adapter=names[0])
+    handles = []
+    t0 = _time.perf_counter()
+    for i, prompt in enumerate(prompts):
+        handles.append(multi.submit(prompt, max_new_tokens=max_new,
+                                    seed=2000 + i,
+                                    adapter=tenant_of[i]))
+    toks = 0
+    while multi.busy:
+        toks += multi.step()
+    multi_wall = _time.perf_counter() - t0
+    multi_done = sum(1 for h in handles if h.state == "done")
+
+    # -- the dedicated baseline: one merged-dense engine per tenant ------
+    merged_wall, merged_done, merged_toks = 0.0, 0, 0
+    for name in names:
+        merged = [_dc.replace(s, params=p) for s, p in
+                  zip(stages, lora.merge_adapter(params_list,
+                                                 adapters[name]))]
+        engine = InferenceEngine(merged, cfg, n_slots=slots,
+                                 block_size=block_size)
+        _warm(engine)
+        mine = [i for i in range(n_requests) if tenant_of[i] == name]
+        t0 = _time.perf_counter()
+        hs = [engine.submit(prompts[i], max_new_tokens=max_new,
+                            seed=2000 + i) for i in mine]
+        while engine.busy:
+            merged_toks += engine.step()
+        merged_wall += _time.perf_counter() - t0
+        merged_done += sum(1 for h in hs if h.state == "done")
+
+    return [{
+        "config": "gpt_serve_multi_adapter",
+        "n_adapters": n_adapters, "adapter_rank": rank,
+        "n_slots": slots, "n_requests": n_requests,
+        "max_new_tokens": max_new,
+        "completed": multi_done,
+        "completed_merged_sequential": merged_done,
+        "tokens_per_sec": round(toks / multi_wall, 1),
+        "tokens_per_sec_merged_sequential": round(
+            merged_toks / merged_wall, 1),
+        "adapter_resident_bytes": store.resident_bytes,
+        "adapter_swaps": store.swaps_total,
+        "base_param_bytes": base_bytes,
+        "merged_param_bytes_total": n_adapters * base_bytes,
+        "param_bytes_saved": (n_adapters - 1) * base_bytes
+        - store.resident_bytes,
         "device_kind": jax.devices()[0].device_kind,
         "backend": jax.default_backend(),
     }]

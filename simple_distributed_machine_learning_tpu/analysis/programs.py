@@ -114,6 +114,19 @@ class ServeSpec:
     # prefetch_ticks is the async host->HBM upload latency in engine ticks
     host_cache_blocks: int = 0
     prefetch_ticks: int = 1
+    # multi-tenant LoRA serving (ISSUE 20): ``n_adapters`` is the device
+    # adapter bank's TOTAL row count (the engine's rule is n_slots + 1;
+    # row 0 is the pinned all-zero base row) and ``adapter_rank`` the
+    # low-rank width of every row; 0 disables adapters. When on, every
+    # decode-path program is rebuilt as its ``adapters=True`` twin —
+    # trailing traced ``(bank, aid[s])`` args — and the bank-row upload
+    # program joins the registry.
+    n_adapters: int = 0
+    adapter_rank: int = 0
+
+    @property
+    def adapters_on(self) -> bool:
+        return self.n_adapters > 0 and self.adapter_rank > 0
 
     @property
     def tp(self) -> int:
@@ -312,6 +325,32 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
     drafts_a = spec((S, K), np.int32, 0, V - 1) if speculative else None
     qrows_a = _sds((S, K, V), np.float32) if speculative else None
 
+    # the multi-tenant adapter bank and its index contracts: the bank is
+    # TRACED data (hot-swap never retraces), the per-slot adapter ids are
+    # gathers into [0, n_adapters) — the scatter-bounds pass proves the
+    # bank-row gathers and the upload's row scatter stay inside the bank
+    bank = aid1 = aids = None
+    if sspec.adapters_on:
+        from simple_distributed_machine_learning_tpu.models.gpt import (
+            make_adapter_bank_update,
+        )
+        N, r, d = sspec.n_adapters, sspec.adapter_rank, cfg.d_model
+        bank = {"aq": _sds((N, L, d, r), np.float32),
+                "bq": _sds((N, L, r, d), np.float32),
+                "av": _sds((N, L, d, r), np.float32),
+                "bv": _sds((N, L, r, d), np.float32)}
+        row_a = {"aq": _sds((L, d, r), np.float32),
+                 "bq": _sds((L, r, d), np.float32),
+                 "av": _sds((L, d, r), np.float32),
+                 "bv": _sds((L, r, d), np.float32)}
+        aid1 = spec((), np.int32, 0, N - 1)
+        aids = spec((S,), np.int32, 0, N - 1)
+        findings += check_builder_memo("make_adapter_bank_update",
+                                       make_adapter_bank_update)
+        programs.append(Program(
+            "adapter_bank_update", make_adapter_bank_update(),
+            (bank, spec((), np.int32, 0, N - 1), row_a)))
+
     def _spec_draft_programs():
         """The draft propose scan + its abstract pool (dense slot layout
         whatever the target layout — the engine's draft discipline)."""
@@ -359,6 +398,28 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
         decode_args = (params, kc, kc, toks, pos, kdS, f32S, top_ks, f32S)
         programs.append(Program("slot_prefill", prefill, prefill_args))
         programs.append(Program("slot_decode", decode, decode_args))
+
+        if sspec.adapters_on:
+            findings += check_builder_memo(
+                "make_slot_prefill[adapters]",
+                lambda: make_slot_prefill(stages, cfg, ml,
+                                          sspec.cache_dtype, mesh=mesh,
+                                          adapters=True))
+            findings += check_builder_memo(
+                "make_slot_decode_step[adapters]",
+                lambda: make_slot_decode_step(stages, cfg, ml,
+                                              sspec.cache_dtype,
+                                              mesh=mesh, adapters=True))
+            programs.append(Program(
+                "slot_prefill_adapter",
+                make_slot_prefill(stages, cfg, ml, sspec.cache_dtype,
+                                  mesh=mesh, adapters=True),
+                prefill_args + (bank, aid1)))
+            programs.append(Program(
+                "slot_decode_adapter",
+                make_slot_decode_step(stages, cfg, ml, sspec.cache_dtype,
+                                      mesh=mesh, adapters=True),
+                decode_args + (bank, aids)))
 
         # the composite tick: prefill -> decode with the pool buffers
         # THREADED the way engine.step does — donated-buffer flow across
@@ -469,6 +530,30 @@ def build_registry(stages, sspec: ServeSpec, mesh=None, draft_stages=None
     programs.append(Program("paged_decode", decode, decode_args))
     programs.append(Program("paged_block_copy", copy, copy_args))
 
+    if sspec.adapters_on:
+        findings += check_builder_memo(
+            "make_paged_prefill_chunk[adapters]",
+            lambda: make_paged_prefill_chunk(stages, cfg, ml, bs,
+                                             sspec.cache_dtype, mesh=mesh,
+                                             adapters=True))
+        findings += check_builder_memo(
+            "make_paged_decode_step[adapters]",
+            lambda: make_paged_decode_step(stages, cfg, ml, bs,
+                                           sspec.cache_dtype, mesh=mesh,
+                                           kernel=kernel, adapters=True))
+        programs.append(Program(
+            "paged_prefill_chunk_adapter",
+            make_paged_prefill_chunk(stages, cfg, ml, bs,
+                                     sspec.cache_dtype, mesh=mesh,
+                                     adapters=True),
+            chunk_args + (bank, aid1)))
+        programs.append(Program(
+            "paged_decode_adapter",
+            make_paged_decode_step(stages, cfg, ml, bs, sspec.cache_dtype,
+                                   mesh=mesh, kernel=kernel,
+                                   adapters=True),
+            decode_args + (bank, aids)))
+
     # the composite tick: chunk -> CoW copy -> decode, pool buffers
     # threaded exactly as engine.step/_ensure_writable_range thread them.
     # A read of the pre-call buffer after any stage donated it is the
@@ -568,7 +653,12 @@ def degraded_spec(sspec: ServeSpec) -> ServeSpec:
                      cache_dtype=(None
                                   if _is_quantized_dtype(sspec.cache_dtype)
                                   else sspec.cache_dtype),
-                     prompt_lens=sspec.prompt_lens)
+                     prompt_lens=sspec.prompt_lens,
+                     # the adapter bank SURVIVES degraded rebuilds —
+                     # engine_factory's _adapter_kw applies to both
+                     # branches (tenants keep serving on the worst day)
+                     n_adapters=sspec.n_adapters,
+                     adapter_rank=sspec.adapter_rank)
 
 
 # -- the HBM-bytes-per-tick model ------------------------------------------
@@ -680,6 +770,32 @@ def hbm_tick_costs(sspec: ServeSpec, n_layers: int | None = None
             out.append(HBMCost(
                 "verify.kv_read", "slot_verify", S * L * ml * row,
                 note=f"the verify queries read the full rows{shard}"))
+    if sspec.adapters_on:
+        # the adapter bank's per-tick traffic: each slot gathers its
+        # tenant's whole A/B row (4 planes x L layers, f32) per decode
+        # dispatch, prefill gathers one row, and every hot-swap/first
+        # admission scatters one row back. Billed with the SAME formula
+        # as the resident gauge (models/lora.py::bank_bytes) so the rows
+        # and predict_adapter_bytes can never disagree on a row's size.
+        # Under TP the bq/bv planes are column-sliced per shard but the
+        # aq/av gathers replicate — billed at the replicated full row.
+        from simple_distributed_machine_learning_tpu.models import lora
+        row_b = lora.bank_bytes(1, L, cfg.d_model, sspec.adapter_rank)
+        paged = sspec.kv_layout == "paged"
+        out.append(HBMCost(
+            "decode.adapter_gather",
+            "paged_decode" if paged else "slot_decode", S * row_b,
+            note=f"{S} slots x one bank row ({L} layers, 4 low-rank "
+                 f"planes, rank {sspec.adapter_rank}) — row 0 (base) "
+                 f"gathers the same bytes of zeros"))
+        out.append(HBMCost(
+            "prefill.adapter_gather",
+            "paged_prefill_chunk" if paged else "slot_prefill", row_b,
+            note="the boarding request's one bank row"))
+        out.append(HBMCost(
+            "adapter.bank_upload", "adapter_bank_update", row_b,
+            note="per hot-swap / first admission: one donated bank-row "
+                 "rewrite (serve_adapter_swaps_total advances by 1)"))
     if K >= 2 and sspec.draft_cfg is not None:
         from simple_distributed_machine_learning_tpu.models.gpt import (
             _is_quantized_dtype,
@@ -732,6 +848,24 @@ def predict_kv_bytes_resident(sspec: ServeSpec, rows_per_seq,
                                sspec.cache_dtype)
     blocks = sum(math.ceil(r / sspec.block_size) for r in rows_per_seq)
     return blocks * per_block
+
+
+def predict_adapter_bytes(sspec: ServeSpec,
+                          n_layers: int | None = None) -> int:
+    """Model of the AdapterStore's ``serve_adapter_resident_bytes`` gauge:
+    HBM the device adapter bank pins — the whole static allocation (every
+    row, resident or not; the bank never reallocates). Computed with the
+    store's OWN formula (:func:`~..models.lora.bank_bytes`), so the parity
+    pin is exact by construction: any drift means the deployment spec and
+    the live store describe different banks
+    (tests/test_adapters.py pins predicted == live)."""
+    if not sspec.adapters_on:
+        return 0
+    from simple_distributed_machine_learning_tpu.models import lora
+    cfg = sspec.cfg
+    L = n_layers if n_layers is not None else cfg.n_layers
+    return lora.bank_bytes(sspec.n_adapters, L, cfg.d_model,
+                           sspec.adapter_rank)
 
 
 def _host_block_bytes(sspec: ServeSpec, n_layers: int | None = None) -> int:
@@ -821,7 +955,10 @@ def lint_serve(stages, sspec: ServeSpec, name: str | None = None,
                         if sspec.cache_dtype is not None else "")
                      + (f" tp={sspec.tp}" if sspec.tp > 1 else "")
                      + (f" spec_k={sspec.spec_k}" if sspec.spec_k
-                        else "") + "]")
+                        else "")
+                     + (f" adapters={sspec.n_adapters}"
+                        f"r{sspec.adapter_rank}"
+                        if sspec.adapters_on else "") + "]")
     report = Report(name=label, findings=list(policy))
     kernel_rows: list[HBMCost] = []
     for prog in programs:
@@ -930,6 +1067,14 @@ def default_registry_reports() -> list[Report]:
                   prefill_chunk=3, prompt_lens=buckets,
                   cache_dtype="int8", attn_kernel="fused"),
         ServeSpec(cfg, n_slots=4, kv_layout="dense", prompt_lens=buckets),
+        # the multi-tenant adapter layouts (ISSUE 20): every decode-path
+        # program's adapters=True twin plus the bank-row upload program,
+        # bank sized by the engine's n_slots + 1 rule
+        ServeSpec(cfg, n_slots=4, kv_layout="paged", block_size=4,
+                  prefill_chunk=3, prompt_lens=buckets, n_adapters=5,
+                  adapter_rank=2),
+        ServeSpec(cfg, n_slots=4, kv_layout="dense", prompt_lens=buckets,
+                  n_adapters=5, adapter_rank=2),
         # the speculative pair (draft propose + batched verify + composite
         # tick) on both layouts — TP deployments need a live multi-device
         # mesh, so the CLI/tests cover those where devices exist
@@ -971,7 +1116,11 @@ def engine_spec(engine, prompt_lens: tuple | None = None) -> ServeSpec:
         draft_cfg=engine.draft_cfg,
         attn_kernel=engine.attn_kernel,
         host_cache_blocks=getattr(pool, "host_cache_blocks", 0),
-        prefetch_ticks=getattr(pool, "prefetch_ticks", 1))
+        prefetch_ticks=getattr(pool, "prefetch_ticks", 1),
+        n_adapters=(0 if getattr(engine, "_adapters", None) is None
+                    else engine._adapters.n_rows),
+        adapter_rank=(0 if getattr(engine, "_adapters", None) is None
+                      else engine._adapters.rank))
 
 
 def lint_engine(engine, prompt_lens: tuple | None = None) -> Report:

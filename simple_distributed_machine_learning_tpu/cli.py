@@ -274,6 +274,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "host->HBM prefetch upload takes (the modeled "
                         "PCIe/DMA latency; boarding blocks until the "
                         "upload lands)")
+    g.add_argument('--serve-adapters', type=int, default=0, metavar='N',
+                   help="with --serve-sim: multi-tenant LoRA serving "
+                        "(serve/adapters.py) — register N per-tenant "
+                        "low-rank adapters (tenant-0..tenant-N-1) over "
+                        "the SHARED base weights and split arrivals "
+                        "evenly across them; each decode tick gathers "
+                        "per-slot adapter rows from one device-resident "
+                        "bank, so ONE compiled program serves any tenant "
+                        "mix (no per-tenant retrace, no merged weight "
+                        "copies). With --serve-replicas the router "
+                        "prefers a replica where the request's adapter "
+                        "is already resident (adapter-affinity)")
+    g.add_argument('--serve-adapter-rank', type=int, default=4,
+                   metavar='R',
+                   help="with --serve-adapters: the low-rank dimension r "
+                        "of every adapter's A/B factors (bank HBM scales "
+                        "linearly with r; see models/lora.py bank_bytes)")
     g.add_argument('--serve-trace', action='store_true',
                    help="with --serve-sim/--scenario and --telemetry-dir: "
                         "request-scoped tracing (serve/tracing.py) — a "
@@ -818,6 +835,7 @@ def _run_serve(args, n_stages: int, key) -> None:
         InferenceEngine,
         ServeMetrics,
         SimConfig,
+        TrafficClass,
         simulate,
     )
 
@@ -887,6 +905,12 @@ def _run_serve(args, n_stages: int, key) -> None:
     if args.serve_host_blocks < 0:
         raise SystemExit(f"--serve-host-blocks must be >= 0 (0 = no host "
                          f"tier), got {args.serve_host_blocks}")
+    if args.serve_adapters < 0:
+        raise SystemExit(f"--serve-adapters must be >= 0 (0 = base model "
+                         f"only), got {args.serve_adapters}")
+    if args.serve_adapters and args.serve_adapter_rank < 1:
+        raise SystemExit(f"--serve-adapter-rank must be >= 1, got "
+                         f"{args.serve_adapter_rank}")
     if args.serve_prefetch_ticks < 1:
         raise SystemExit(f"--serve-prefetch-ticks must be >= 1, got "
                          f"{args.serve_prefetch_ticks}")
@@ -974,7 +998,14 @@ def _run_serve(args, n_stages: int, key) -> None:
             block_size=args.serve_block_size,
             prefill_chunk=(args.serve_prefill_chunk or None),
             prompt_lens=buckets, spec_k=args.serve_spec_k,
-            draft_cfg=draft_cfg), mesh=mesh, draft_stages=draft_stages)
+            draft_cfg=draft_cfg,
+            # the engine's AdapterStore sizes the bank n_slots + 1 (row 0
+            # = the zero base row), so the linted layouts are the EXACT
+            # programs the adapter ticks below will execute
+            n_adapters=(args.serve_slots + 1 if args.serve_adapters
+                        else 0),
+            adapter_rank=(args.serve_adapter_rank if args.serve_adapters
+                          else 0)), mesh=mesh, draft_stages=draft_stages)
         print(report.format(costs=True))
         if not report.ok():
             raise SystemExit(2)
@@ -1035,6 +1066,17 @@ def _run_serve(args, n_stages: int, key) -> None:
         prefetch_ticks=args.serve_prefetch_ticks,
         metrics=metrics, mesh=mesh, draft_stages=draft_stages,
         draft_cfg=draft_cfg, spec_k=args.serve_spec_k)
+    if args.serve_adapters:
+        if fleet_mode or supervised:
+            # the engine factory builds (and rebuilds, after a crash)
+            # each engine's AdapterStore over one shared host dict
+            engine_kw["adapter_rank"] = args.serve_adapter_rank
+        else:
+            from simple_distributed_machine_learning_tpu.serve.adapters import (  # noqa: E501
+                AdapterStore,
+            )
+            engine_kw["adapters"] = AdapterStore(
+                serve_cfg, args.serve_adapter_rank, args.serve_slots)
     tmpdir = None
     if fleet_mode:
         # the multi-replica path: N supervised engines behind the
@@ -1111,6 +1153,21 @@ def _run_serve(args, n_stages: int, key) -> None:
     else:
         engine = InferenceEngine(stages, serve_cfg, trace=trace,
                                  **engine_kw)
+    if args.serve_adapters:
+        # seeded per-tenant weights off the run key: register on the
+        # serving target (engine / supervisor / fleet — one call shape);
+        # device rows upload lazily at each replica's admission ticks
+        import jax as _jax
+
+        from simple_distributed_machine_learning_tpu.models import lora
+        for k in range(args.serve_adapters):
+            engine.register_adapter(
+                f"tenant-{k}",
+                lora.init_lora_adapter(_jax.random.fold_in(key, 7000 + k),
+                                       serve_cfg,
+                                       args.serve_adapter_rank))
+        print(f"| serve: {args.serve_adapters} LoRA tenant(s) rank "
+              f"{args.serve_adapter_rank} over shared base weights")
     max_new = min(args.serve_max_new, cfg.seq_len - longest)
     if max_new < args.serve_max_new:
         print(f"| serve: --serve-max-new {args.serve_max_new} clamped to "
@@ -1119,7 +1176,13 @@ def _run_serve(args, n_stages: int, key) -> None:
     sim = SimConfig(n_requests=args.serve_sim, rate=args.serve_rate,
                     seed=args.seed, prompt_lens=GPT_SERVE_PROMPTS,
                     max_new_tokens=max_new,
-                    shared_prefix_len=args.serve_shared_prefix)
+                    shared_prefix_len=args.serve_shared_prefix,
+                    # multi-tenant adapters: arrivals split evenly across
+                    # the tenants, each request decoding its own adapter
+                    classes=tuple(
+                        TrafficClass(name=f"tenant-{k}",
+                                     adapter=f"tenant-{k}")
+                        for k in range(args.serve_adapters)))
     # graceful shutdown: SIGTERM/SIGINT stop admission, drain in-flight
     # requests, flush metrics + journal and exit 0 — the operational
     # complement of crash recovery (a rollout must not look like a fault)
@@ -1195,6 +1258,13 @@ def _run_serve(args, n_stages: int, key) -> None:
             print(f"| serve: {len(engine.postmortems)} post-mortem "
                   f"bundle(s): "
                   f"{[os.path.basename(p) for p in engine.postmortems]}")
+    if args.serve_adapters:
+        print(f"| serve: adapters — "
+              f"{s.get('adapter_resident_bytes', 0)} bank bytes "
+              f"resident, {s.get('adapter_swaps', 0)} bank upload(s), "
+              f"{s.get('route_adapter_affinity_hits', 0)} "
+              f"adapter-affinity hit(s), per-tenant completed "
+              f"{s.get('per_adapter_completed', {})}")
     if "kv_drift_bytes" in s:
         print(f"| serve: kv drift {s['kv_drift_bytes']} bytes vs the "
               f"analyzer model (predicted {s['kv_bytes_predicted']})")

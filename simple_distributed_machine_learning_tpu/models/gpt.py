@@ -42,6 +42,7 @@ from simple_distributed_machine_learning_tpu.ops.layers import (
     linear,
     linear_init,
 )
+from simple_distributed_machine_learning_tpu.models.lora import lora_delta
 from simple_distributed_machine_learning_tpu.ops.losses import log_softmax
 from simple_distributed_machine_learning_tpu.parallel.pipeline import Stage
 
@@ -585,14 +586,46 @@ def _filter_top(scaled: jax.Array, top_k: int | None,
     return scaled
 
 
-def _dense_qkv(bp, h, n_heads):
+def _dense_qkv(bp, h, n_heads, ab=None):
     """ln1 + QKV projections of one dense block — the ONE copy shared by the
     cached and pipeline-parallel decoders (prefill and step), so their math
-    can never drift apart."""
+    can never drift apart.
+
+    ``ab`` (optional): this layer's LoRA factors ``(aq, bq, av, bv)`` — the
+    multi-tenant serving path's merge-free per-request delta,
+    ``q += (hn @ aq) @ bq`` (same for v, k unadapted; classic LoRA
+    targets). Factors are unbatched ``[d, r]`` in prefill (one request) or
+    leading-``[S]``-batched in the ticks (each slot's own gathered
+    adapter) — :func:`~.lora.lora_delta`'s matmul broadcasting covers
+    both. The all-zero base row contributes an exact 0 delta, so base
+    requests keep the adapter-free token stream."""
     hn = layer_norm(bp["ln1"], h)
-    return (_split_heads(hn @ bp["attn"]["wq"], n_heads),
+    q = hn @ bp["attn"]["wq"]
+    v = hn @ bp["attn"]["wv"]
+    if ab is not None:
+        aq, bq, av, bv = ab
+        q = q + lora_delta(hn, aq, bq)
+        v = v + lora_delta(hn, av, bv)
+    return (_split_heads(q, n_heads),
             _split_heads(hn @ bp["attn"]["wk"], n_heads),
-            _split_heads(hn @ bp["attn"]["wv"], n_heads))
+            _split_heads(v, n_heads))
+
+
+def _adapter_layers(bank, aid):
+    """Per-request adapter slices for the decode-path programs: gather
+    row(s) ``aid`` (a traced scalar for one-request prefill, ``[S]`` for
+    the batched ticks) from the stacked bank
+    (``{"aq": [N, L, d, r], "bq": [N, L, r, d], "av": ..., "bv": ...}``)
+    and return a per-layer lookup ``at(li) -> (aq, bq, av, bv)`` feeding
+    :func:`_dense_qkv`. The gather is data — one compiled program serves
+    any adapter mix per tick, and a bank-row hot-swap never retraces."""
+    sel = {k: bank[k][aid] for k in ("aq", "bq", "av", "bv")}
+
+    def at(li):
+        return tuple(sel[k][..., li, :, :]
+                     for k in ("aq", "bq", "av", "bv"))
+
+    return at
 
 
 def _dense_attn_tail(bp, h, a):
@@ -786,6 +819,32 @@ def _close_rows(rows):
         MODEL_AXIS,
     )
     return lax.pmean(rows, MODEL_AXIS)
+
+
+def _tp_adapter_layers(bank, aid, tp):
+    """TP twin of :func:`_adapter_layers` — call inside ``shard_map``. The
+    bank arrives replicated (it is tiny next to the weights); each shard
+    slices its LOCAL output columns of the B factors — ``bq``/``bv``
+    columns are head-aligned exactly like ``wq``/``wv``'s Megatron column
+    shards, and column slicing commutes with the matmul — so the local
+    delta lands on the same columns the local base projection produces,
+    bit-identically to the dense build's slice."""
+    from jax import lax
+
+    from simple_distributed_machine_learning_tpu.parallel.mesh import (
+        MODEL_AXIS,
+    )
+    at_full = _adapter_layers(bank, aid)
+    m = lax.axis_index(MODEL_AXIS)
+
+    def at(li):
+        aq, bq, av, bv = at_full(li)
+        dc = bq.shape[-1] // tp
+        bq = lax.dynamic_slice_in_dim(bq, m * dc, dc, bq.ndim - 1)
+        bv = lax.dynamic_slice_in_dim(bv, m * dc, dc, bv.ndim - 1)
+        return aq, bq, av, bv
+
+    return at
 
 
 def _tp_jit(body, mesh, n_buf_in, n_rest_in, n_buf_out, n_rest_out,
@@ -1198,10 +1257,19 @@ def _validate_slot_build(stages, cfg: GPTConfig, max_len: int,
 
 
 def make_slot_prefill(stages, cfg: GPTConfig, max_len: int,
-                      cache_dtype=None, mesh=None):
+                      cache_dtype=None, mesh=None,
+                      adapters: bool = False):
     """Serving prefill-into-slot: ``prefill(params, kc, vc, prompt [1, T0],
     slot, key_data, temperature, top_k, top_p) -> (kc, vc, token,
     key_data)``.
+
+    ``adapters=True`` builds the multi-tenant variant: two TRACED args
+    append to the signature — the stacked adapter ``bank`` pytree and the
+    request's bank-row index ``aid`` — and every block's q/v projection
+    adds the gathered low-rank delta (:func:`_dense_qkv`). One static
+    BOOL in the memo key: bank contents, row count and rank are all data,
+    so adapter registration/hot-swap never retraces and any adapter mix
+    shares this one program.
 
     Runs ONE request's prompt through every block (batch 1, exactly the
     solo decoder's prefill shapes and math — shared :func:`_dense_qkv` /
@@ -1229,21 +1297,25 @@ def make_slot_prefill(stages, cfg: GPTConfig, max_len: int,
                          cache_dtype)
     mesh = _validate_tp_serve(cfg, mesh, "make_slot_prefill")
     H = cfg.n_heads
-    key_ = ("slot_prefill", cfg, max_len, mesh)
+    key_ = ("slot_prefill", cfg, max_len, mesh, adapters)
     if cfg.n_tensor_parallel > 1:
-        return _memo_build(key_, lambda: _build_slot_prefill_tp(cfg, mesh))
-    return _memo_build(key_, lambda: _build_slot_prefill(H))
+        return _memo_build(key_, lambda: _build_slot_prefill_tp(cfg, mesh,
+                                                                adapters))
+    return _memo_build(key_, lambda: _build_slot_prefill(H, adapters))
 
 
-def _slot_prefill_fwd(blocks, embed, head, kc, vc, prompt, slot, H, tail):
+def _slot_prefill_fwd(blocks, embed, head, kc, vc, prompt, slot, H, tail,
+                      ab_at=None):
     """One request's whole-prompt prefill into pool row ``slot`` — the one
     copy of the math, shared by the single-device and TP builds (``H`` is
-    the LOCAL head count; ``tail`` closes each block)."""
+    the LOCAL head count; ``tail`` closes each block; ``ab_at`` is the
+    optional per-layer adapter lookup of :func:`_adapter_layers`)."""
     t0 = prompt.shape[1]
     ids = prompt.astype(jnp.int32)
     h = embedding_lookup(embed["tok"], ids) + embed["pos"][:t0]
     for li, bp in enumerate(blocks):
-        q, k_, v = _dense_qkv(bp, h, H)               # [1, H, T0, dh]
+        q, k_, v = _dense_qkv(bp, h, H,               # [1, H, T0, dh]
+                              None if ab_at is None else ab_at(li))
         kc = jax.lax.dynamic_update_slice(
             kc, k_.astype(kc.dtype)[None], (li, slot, 0, 0, 0))
         vc = jax.lax.dynamic_update_slice(
@@ -1252,39 +1324,70 @@ def _slot_prefill_fwd(blocks, embed, head, kc, vc, prompt, slot, H, tail):
     return kc, vc, _head_logprobs(head, h[:, -1])[0]  # row: [V]
 
 
-def _build_slot_prefill(H):
+def _build_slot_prefill(H, adapters=False):
+    def run(params, kc, vc, prompt, slot, key_data, temperature, top_k,
+            top_p, ab_at=None):
+        embed, blocks, head = _merged_stage_trees(params)
+        kc, vc, row = _slot_prefill_fwd(blocks, embed, head, kc, vc,
+                                        prompt, slot, H, _dense_attn_tail,
+                                        ab_at)
+        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
+        return kc, vc, tok, kd
+
+    if adapters:
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(params, kc, vc, prompt, slot, key_data, temperature,
+                    top_k, top_p, bank, aid):
+            return run(params, kc, vc, prompt, slot, key_data,
+                       temperature, top_k, top_p,
+                       _adapter_layers(bank, aid))
+
+        return prefill
+
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def prefill(params, kc, vc, prompt, slot, key_data, temperature,
                 top_k, top_p):
-        embed, blocks, head = _merged_stage_trees(params)
-        kc, vc, row = _slot_prefill_fwd(blocks, embed, head, kc, vc,
-                                        prompt, slot, H, _dense_attn_tail)
-        tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
-        return kc, vc, tok, kd
+        return run(params, kc, vc, prompt, slot, key_data, temperature,
+                   top_k, top_p)
 
     return prefill
 
 
-def _build_slot_prefill_tp(cfg, mesh):
+def _build_slot_prefill_tp(cfg, mesh, adapters=False):
     tp = cfg.n_tensor_parallel
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
     H_loc = cfg.n_heads // tp
 
-    def body(params, kc, vc, prompt, slot, key_data, temperature,
-             top_k, top_p):
+    def run(params, kc, vc, prompt, slot, key_data, temperature,
+            top_k, top_p, ab_at=None):
         blocks, embed, head = _tp_local_trees(params)
         kc, vc, row = _slot_prefill_fwd(blocks, embed, head, kc, vc,
-                                        prompt, slot, H_loc, tail)
+                                        prompt, slot, H_loc, tail, ab_at)
         row = _close_rows(row)
         tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
         return kc, vc, tok, kd
+
+    if adapters:
+        def body(params, kc, vc, prompt, slot, key_data, temperature,
+                 top_k, top_p, bank, aid):
+            return run(params, kc, vc, prompt, slot, key_data,
+                       temperature, top_k, top_p,
+                       _tp_adapter_layers(bank, aid, tp))
+
+        return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=8, n_buf_out=2,
+                       n_rest_out=2)
+
+    def body(params, kc, vc, prompt, slot, key_data, temperature,
+             top_k, top_p):
+        return run(params, kc, vc, prompt, slot, key_data, temperature,
+                   top_k, top_p)
 
     return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=6, n_buf_out=2,
                    n_rest_out=2)
 
 
 def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads,
-                            tail=_dense_attn_tail):
+                            tail=_dense_attn_tail, ab=None):
     """One block on one token per SLOT (``h``: [S, 1, d]) against pool
     cache row ``li``; each slot writes its new K/V at its OWN position
     (``pos``: [S]) and attends ``[0, pos]``. Per-slot math is exactly
@@ -1292,8 +1395,9 @@ def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads,
     masked-row softmax), and every slot's output depends only on its own
     cache row — the bit-exactness anchor continuous batching rests on.
     ``n_heads`` is the LOCAL head count and ``tail`` closes the block (the
-    TP build passes ``H/tp`` and :func:`_tp_attn_tail`)."""
-    q, knew, vnew = _dense_qkv(bp, h, n_heads)            # [S, H, 1, dh]
+    TP build passes ``H/tp`` and :func:`_tp_attn_tail`); ``ab`` is this
+    layer's optional batched adapter factors (:func:`_dense_qkv`)."""
+    q, knew, vnew = _dense_qkv(bp, h, n_heads, ab)        # [S, H, 1, dh]
     # scale from the PROJECTED head dim (q's trailing axis), never from
     # h.shape[-1] // n_heads: under TP the local head count shrinks but the
     # per-head dim does not, and a local-count-derived scale silently
@@ -1317,7 +1421,8 @@ def _dense_block_step_slots(bp, h, li, kc, vc, pos, n_heads,
 
 
 def make_slot_decode_step(stages, cfg: GPTConfig, max_len: int,
-                          cache_dtype=None, mesh=None):
+                          cache_dtype=None, mesh=None,
+                          adapters: bool = False):
     """Serving decode tick: ``step(params, kc, vc, toks [S], pos [S],
     key_data [S, 2], temps [S], top_ks [S], top_ps [S]) -> (kc, vc,
     next_toks [S], next_key_data [S, 2])``.
@@ -1335,54 +1440,90 @@ def make_slot_decode_step(stages, cfg: GPTConfig, max_len: int,
 
     With ``cfg.n_tensor_parallel > 1`` (pass the ``mesh``): the shard_map
     twin over the head-sharded pool (:func:`make_slot_prefill`'s TP notes
-    apply).
+    apply). ``adapters=True`` appends the traced ``(bank, aids [S])``
+    multi-tenant args — each slot gathers its OWN adapter's low-rank
+    factors by index, so one program serves any adapter mix per tick
+    (:func:`make_slot_prefill`'s adapter notes apply).
     """
     _validate_slot_build(stages, cfg, max_len, "make_slot_decode_step",
                          cache_dtype)
     mesh = _validate_tp_serve(cfg, mesh, "make_slot_decode_step")
     H = cfg.n_heads
-    key_ = ("slot_decode", cfg, max_len, mesh)
+    key_ = ("slot_decode", cfg, max_len, mesh, adapters)
     if cfg.n_tensor_parallel > 1:
-        return _memo_build(key_, lambda: _build_slot_decode_tp(cfg, mesh))
-    return _memo_build(key_, lambda: _build_slot_decode(H))
+        return _memo_build(key_, lambda: _build_slot_decode_tp(cfg, mesh,
+                                                               adapters))
+    return _memo_build(key_, lambda: _build_slot_decode(H, adapters))
 
 
-def _slot_decode_fwd(blocks, embed, head, kc, vc, toks, pos, H, tail):
+def _slot_decode_fwd(blocks, embed, head, kc, vc, toks, pos, H, tail,
+                     ab_at=None):
     """The batched one-token-per-slot step's forward — shared by the
-    single-device and TP builds and by the speculative draft proposer."""
+    single-device and TP builds and by the speculative draft proposer
+    (which always runs base-model: the draft never takes ``ab_at``)."""
     pe = jnp.take(embed["pos"], pos, axis=0)[:, None]      # [S, 1, d]
     h = embedding_lookup(embed["tok"], toks[:, None]) + pe
     for li, bp in enumerate(blocks):
-        h, kc, vc = _dense_block_step_slots(bp, h, li, kc, vc, pos, H,
-                                            tail)
+        h, kc, vc = _dense_block_step_slots(
+            bp, h, li, kc, vc, pos, H, tail,
+            None if ab_at is None else ab_at(li))
     return kc, vc, _head_logprobs(head, h[:, 0])           # rows: [S, V]
 
 
-def _build_slot_decode(H):
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def step(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+def _build_slot_decode(H, adapters=False):
+    def run(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps,
+            ab_at=None):
         embed, blocks, head = _merged_stage_trees(params)
         kc, vc, rows = _slot_decode_fwd(blocks, embed, head, kc, vc, toks,
-                                        pos, H, _dense_attn_tail)
+                                        pos, H, _dense_attn_tail, ab_at)
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
         return kc, vc, toks2, kd2
+
+    if adapters:
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, kc, vc, toks, pos, key_data, temps, top_ks,
+                 top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, key_data, temps,
+                       top_ks, top_ps, _adapter_layers(bank, aids))
+
+        return step
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+        return run(params, kc, vc, toks, pos, key_data, temps, top_ks,
+                   top_ps)
 
     return step
 
 
-def _build_slot_decode_tp(cfg, mesh):
+def _build_slot_decode_tp(cfg, mesh, adapters=False):
+    tp = cfg.n_tensor_parallel
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
-    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+    H_loc = cfg.n_heads // tp
 
-    def body(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+    def run(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps,
+            ab_at=None):
         blocks, embed, head = _tp_local_trees(params)
         kc, vc, rows = _slot_decode_fwd(blocks, embed, head, kc, vc, toks,
-                                        pos, H_loc, tail)
+                                        pos, H_loc, tail, ab_at)
         rows = _close_rows(rows)
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
         return kc, vc, toks2, kd2
+
+    if adapters:
+        def body(params, kc, vc, toks, pos, key_data, temps, top_ks,
+                 top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, key_data, temps,
+                       top_ks, top_ps, _tp_adapter_layers(bank, aids, tp))
+
+        return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=8, n_buf_out=2,
+                       n_rest_out=2)
+
+    def body(params, kc, vc, toks, pos, key_data, temps, top_ks, top_ps):
+        return run(params, kc, vc, toks, pos, key_data, temps, top_ks,
+                   top_ps)
 
     return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=6, n_buf_out=2,
                    n_rest_out=2)
@@ -1470,7 +1611,8 @@ def _check_attn_kernel(kernel: str, caller: str) -> str:
 
 
 def make_paged_prefill_chunk(stages, cfg: GPTConfig, max_len: int,
-                             block_size: int, cache_dtype=None, mesh=None):
+                             block_size: int, cache_dtype=None, mesh=None,
+                             adapters: bool = False):
     """Chunked serving prefill into paged blocks: ``chunk(params, kc, vc,
     tokens [1, c], p0, table [NB], key_data, temperature, top_k, top_p) ->
     (kc, vc, token, key_data)``.
@@ -1499,21 +1641,24 @@ def make_paged_prefill_chunk(stages, cfg: GPTConfig, max_len: int,
 
     ``kc``/``vc`` (``[L, n_blocks+1, H, block_size, dh]``) are donated —
     the engine always threads the returned buffers back into the pool.
+    ``adapters=True`` appends the traced ``(bank, aid)`` multi-tenant
+    args (:func:`make_slot_prefill`'s adapter notes apply).
     """
     _validate_paged_build(stages, cfg, max_len, block_size,
                           "make_paged_prefill_chunk", cache_dtype)
     mesh = _validate_tp_serve(cfg, mesh, "make_paged_prefill_chunk")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
-    key_ = ("paged_chunk", cfg, max_len, block_size, mesh)
+    key_ = ("paged_chunk", cfg, max_len, block_size, mesh, adapters)
     if cfg.n_tensor_parallel > 1:
         return _memo_build(key_, lambda: _build_paged_prefill_chunk_tp(
-            cfg, bs, dh, mesh))
-    return _memo_build(key_, lambda: _build_paged_prefill_chunk(H, bs, dh))
+            cfg, bs, dh, mesh, adapters))
+    return _memo_build(key_, lambda: _build_paged_prefill_chunk(
+        H, bs, dh, adapters))
 
 
 def _paged_chunk_fwd(blocks, embed, head, kc, vc, tokens, p0, table, H, bs,
-                     dh, tail):
+                     dh, tail, ab_at=None):
     """One prompt chunk's scatter + block-gather attention — the shared
     forward of the single-device and TP paged prefill builds."""
     c = tokens.shape[1]
@@ -1526,7 +1671,8 @@ def _paged_chunk_fwd(blocks, embed, head, kc, vc, tokens, p0, table, H, bs,
     span = table.shape[0] * bs
     live = (jnp.arange(span)[None, :] <= idx[:, None])[None, None]
     for li, bp in enumerate(blocks):
-        q, k_, v = _dense_qkv(bp, h, H)           # [1, H, c, dh]
+        q, k_, v = _dense_qkv(bp, h, H,           # [1, H, c, dh]
+                              None if ab_at is None else ab_at(li))
         kc = _paged_scatter(kc, li, phys, off, k_[0].swapaxes(0, 1))
         vc = _paged_scatter(vc, li, phys, off, v[0].swapaxes(0, 1))
         krow = _paged_gather(kc, li, table)       # [H, span, dh]
@@ -1539,33 +1685,64 @@ def _paged_chunk_fwd(blocks, embed, head, kc, vc, tokens, p0, table, H, bs,
     return kc, vc, _head_logprobs(head, h[:, -1])[0]    # row: [V]
 
 
-def _build_paged_prefill_chunk(H, bs, dh):
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def chunk(params, kc, vc, tokens, p0, table, key_data, temperature,
-              top_k, top_p):
+def _build_paged_prefill_chunk(H, bs, dh, adapters=False):
+    def run(params, kc, vc, tokens, p0, table, key_data, temperature,
+            top_k, top_p, ab_at=None):
         embed, blocks, head = _merged_stage_trees(params)
         kc, vc, row = _paged_chunk_fwd(blocks, embed, head, kc, vc,
                                        tokens, p0, table, H, bs, dh,
-                                       _dense_attn_tail)
+                                       _dense_attn_tail, ab_at)
         tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
         return kc, vc, tok, kd
+
+    if adapters:
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def chunk(params, kc, vc, tokens, p0, table, key_data,
+                  temperature, top_k, top_p, bank, aid):
+            return run(params, kc, vc, tokens, p0, table, key_data,
+                       temperature, top_k, top_p,
+                       _adapter_layers(bank, aid))
+
+        return chunk
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def chunk(params, kc, vc, tokens, p0, table, key_data, temperature,
+              top_k, top_p):
+        return run(params, kc, vc, tokens, p0, table, key_data,
+                   temperature, top_k, top_p)
 
     return chunk
 
 
-def _build_paged_prefill_chunk_tp(cfg, bs, dh, mesh):
+def _build_paged_prefill_chunk_tp(cfg, bs, dh, mesh, adapters=False):
+    tp = cfg.n_tensor_parallel
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
-    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+    H_loc = cfg.n_heads // tp
 
-    def body(params, kc, vc, tokens, p0, table, key_data, temperature,
-             top_k, top_p):
+    def run(params, kc, vc, tokens, p0, table, key_data, temperature,
+            top_k, top_p, ab_at=None):
         blocks, embed, head = _tp_local_trees(params)
         kc, vc, row = _paged_chunk_fwd(blocks, embed, head, kc, vc,
                                        tokens, p0, table, H_loc, bs, dh,
-                                       tail)
+                                       tail, ab_at)
         row = _close_rows(row)
         tok, kd = _sample_dyn(row, key_data, temperature, top_k, top_p)
         return kc, vc, tok, kd
+
+    if adapters:
+        def body(params, kc, vc, tokens, p0, table, key_data, temperature,
+                 top_k, top_p, bank, aid):
+            return run(params, kc, vc, tokens, p0, table, key_data,
+                       temperature, top_k, top_p,
+                       _tp_adapter_layers(bank, aid, tp))
+
+        return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=9, n_buf_out=2,
+                       n_rest_out=2)
+
+    def body(params, kc, vc, tokens, p0, table, key_data, temperature,
+             top_k, top_p):
+        return run(params, kc, vc, tokens, p0, table, key_data,
+                   temperature, top_k, top_p)
 
     return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=7, n_buf_out=2,
                    n_rest_out=2)
@@ -1573,7 +1750,8 @@ def _build_paged_prefill_chunk_tp(cfg, bs, dh, mesh):
 
 def make_paged_decode_step(stages, cfg: GPTConfig, max_len: int,
                            block_size: int, cache_dtype=None, mesh=None,
-                           kernel: str = "dense"):
+                           kernel: str = "dense",
+                           adapters: bool = False):
     """Paged serving decode tick: ``step(params, kc, vc, toks [S], pos [S],
     tables [S, NB], key_data [S, 2], temps [S], top_ks [S], top_ps [S]) ->
     (kc, vc, next_toks [S], next_key_data [S, 2])``.
@@ -1611,16 +1789,17 @@ def make_paged_decode_step(stages, cfg: GPTConfig, max_len: int,
     _check_attn_kernel(kernel, "make_paged_decode_step")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
-    key_ = ("paged_decode", cfg, max_len, block_size, mesh, kernel)
+    key_ = ("paged_decode", cfg, max_len, block_size, mesh, kernel,
+            adapters)
     if cfg.n_tensor_parallel > 1:
         return _memo_build(key_, lambda: _build_paged_decode_step_tp(
-            cfg, bs, dh, mesh, kernel))
-    return _memo_build(key_, lambda: _build_paged_decode_step(H, bs, dh,
-                                                              kernel))
+            cfg, bs, dh, mesh, kernel, adapters))
+    return _memo_build(key_, lambda: _build_paged_decode_step(
+        H, bs, dh, kernel, adapters))
 
 
 def _paged_decode_fwd(blocks, embed, head, kc, vc, toks, pos, tables, H, bs,
-                      dh, tail, kernel="dense"):
+                      dh, tail, kernel="dense", ab_at=None):
     """The batched one-token-per-slot block-gather step's forward — shared
     by the single-device and TP paged decode builds. ``kernel`` selects the
     attention path: ``"dense"`` gathers each slot's table span into a
@@ -1638,7 +1817,8 @@ def _paged_decode_fwd(blocks, embed, head, kc, vc, toks, pos, tables, H, bs,
     live = (jnp.arange(span)[None, None, None, :]
             <= pos[:, None, None, None])
     for li, bp in enumerate(blocks):
-        q, knew, vnew = _dense_qkv(bp, h, H)              # [S, H, 1, dh]
+        q, knew, vnew = _dense_qkv(bp, h, H,              # [S, H, 1, dh]
+                                   None if ab_at is None else ab_at(li))
         kc = _paged_scatter(kc, li, phys, off, knew[:, :, 0, :])
         vc = _paged_scatter(vc, li, phys, off, vnew[:, :, 0, :])
         if kernel == "fused":
@@ -1655,35 +1835,66 @@ def _paged_decode_fwd(blocks, embed, head, kc, vc, toks, pos, tables, H, bs,
     return kc, vc, _head_logprobs(head, h[:, 0])          # rows: [S, V]
 
 
-def _build_paged_decode_step(H, bs, dh, kernel="dense"):
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def step(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
-             top_ps):
+def _build_paged_decode_step(H, bs, dh, kernel="dense", adapters=False):
+    def run(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
+            top_ps, ab_at=None):
         embed, blocks, head = _merged_stage_trees(params)
         kc, vc, rows = _paged_decode_fwd(blocks, embed, head, kc, vc, toks,
                                          pos, tables, H, bs, dh,
-                                         _dense_attn_tail, kernel)
+                                         _dense_attn_tail, kernel, ab_at)
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
         return kc, vc, toks2, kd2
+
+    if adapters:
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, kc, vc, toks, pos, tables, key_data, temps,
+                 top_ks, top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, tables, key_data,
+                       temps, top_ks, top_ps, _adapter_layers(bank, aids))
+
+        return step
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
+             top_ps):
+        return run(params, kc, vc, toks, pos, tables, key_data, temps,
+                   top_ks, top_ps)
 
     return step
 
 
-def _build_paged_decode_step_tp(cfg, bs, dh, mesh, kernel="dense"):
+def _build_paged_decode_step_tp(cfg, bs, dh, mesh, kernel="dense",
+                                adapters=False):
+    tp = cfg.n_tensor_parallel
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
-    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+    H_loc = cfg.n_heads // tp
 
-    def body(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
-             top_ps):
+    def run(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
+            top_ps, ab_at=None):
         blocks, embed, head = _tp_local_trees(params)
         kc, vc, rows = _paged_decode_fwd(blocks, embed, head, kc, vc, toks,
                                          pos, tables, H_loc, bs, dh, tail,
-                                         kernel)
+                                         kernel, ab_at)
         rows = _close_rows(rows)
         toks2, kd2 = jax.vmap(_sample_dyn)(rows, key_data, temps,
                                            top_ks, top_ps)
         return kc, vc, toks2, kd2
+
+    if adapters:
+        def body(params, kc, vc, toks, pos, tables, key_data, temps,
+                 top_ks, top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, tables, key_data,
+                       temps, top_ks, top_ps,
+                       _tp_adapter_layers(bank, aids, tp))
+
+        return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=9, n_buf_out=2,
+                       n_rest_out=2)
+
+    def body(params, kc, vc, toks, pos, tables, key_data, temps, top_ks,
+             top_ps):
+        return run(params, kc, vc, toks, pos, tables, key_data, temps,
+                   top_ks, top_ps)
 
     return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=7, n_buf_out=2,
                    n_rest_out=2)
@@ -1711,6 +1922,25 @@ def make_paged_block_copy():
         return copy
 
     return _memo_build(("paged_block_copy",), build)
+
+
+def make_adapter_bank_update():
+    """The tick-boundary adapter upload: ``update(bank, idx, adapter) ->
+    bank`` rewrites ONE row of the stacked adapter bank in place (the
+    bank is donated; ``idx`` is a traced scalar so one compiled program
+    serves every upload/evict). This is how the AdapterStore hot-swaps a
+    tenant's weights between ticks without retracing any decode program:
+    the decode builders close over bank SHAPES only — bank contents are
+    traced data, so a row rewrite is invisible to the trace cache."""
+    def build():
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def update(bank, idx, adapter):
+            return jax.tree.map(lambda b, a: b.at[idx].set(a), bank,
+                                adapter)
+
+        return update
+
+    return _memo_build(("adapter_bank_update",), build)
 
 
 # -- speculative decoding ---------------------------------------------------
@@ -1909,7 +2139,8 @@ def _build_slot_propose(H, K, ml):
     return propose
 
 
-def _slot_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wpos, H, tail):
+def _slot_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wpos, H, tail,
+                     ab_at=None):
     """K-tokens-per-slot verify forward over the dense slot pool (``xs``:
     [S, K] input tokens, ``qpos``: [S, K] query positions, ``wpos``:
     [S, K] K/V write positions — ``qpos`` in budget, the never-live trash
@@ -1924,7 +2155,8 @@ def _slot_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wpos, H, tail):
     live = (jnp.arange(ml)[None, None, None, :]
             <= qpos[:, None, :, None])                       # [S,1,K,ml]
     for li, bp in enumerate(blocks):
-        q, knew, vnew = _dense_qkv(bp, h, H)                 # [S, H, K, dh]
+        q, knew, vnew = _dense_qkv(                          # [S, H, K, dh]
+            bp, h, H, None if ab_at is None else ab_at(li))
         dh = q.shape[-1]          # the projected head dim (TP-safe scale)
 
         def upd(cache, new, wp):
@@ -1943,7 +2175,8 @@ def _slot_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wpos, H, tail):
 
 
 def make_slot_verify_step(stages, cfg: GPTConfig, max_len: int, spec_k: int,
-                          cache_dtype=None, mesh=None):
+                          cache_dtype=None, mesh=None,
+                          adapters: bool = False):
     """Target verify tick (dense layout): ``verify(params, kc, vc,
     toks [S], pos [S], drafts [S, K], draft_rows [S, K, V],
     valid_n [S], key_data [S, 2], temps [S], top_ks [S], top_ps [S]) ->
@@ -1965,12 +2198,12 @@ def make_slot_verify_step(stages, cfg: GPTConfig, max_len: int, spec_k: int,
     _check_spec_k(spec_k, "make_slot_verify_step")
     mesh = _validate_tp_serve(cfg, mesh, "make_slot_verify_step")
     H = cfg.n_heads
-    key_ = ("slot_verify", cfg, max_len, spec_k, mesh)
+    key_ = ("slot_verify", cfg, max_len, spec_k, mesh, adapters)
     if cfg.n_tensor_parallel > 1:
         return _memo_build(key_, lambda: _build_slot_verify_tp(
-            cfg, spec_k, max_len, mesh))
+            cfg, spec_k, max_len, mesh, adapters))
     return _memo_build(key_, lambda: _build_slot_verify(H, spec_k,
-                                                        max_len))
+                                                        max_len, adapters))
 
 
 def _verify_positions(pos, valid_n, K, ml):
@@ -1983,46 +2216,78 @@ def _verify_positions(pos, valid_n, K, ml):
     return qpos, wpos
 
 
-def _build_slot_verify(H, K, ml):
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
-               key_data, temps, top_ks, top_ps):
+def _build_slot_verify(H, K, ml, adapters=False):
+    def run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+            key_data, temps, top_ks, top_ps, ab_at=None):
         embed, blocks, head = _merged_stage_trees(params)
         xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
         qpos, wpos = _verify_positions(pos, valid_n, K, ml)
         kc, vc, rows = _slot_verify_fwd(blocks, embed, head, kc, vc, xs,
-                                        qpos, wpos, H, _dense_attn_tail)
+                                        qpos, wpos, H, _dense_attn_tail,
+                                        ab_at)
         toks2, n_acc, kd2 = _spec_accept_rows(
             rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
             top_ps)
         return kc, vc, toks2, n_acc, kd2
 
+    if adapters:
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                   key_data, temps, top_ks, top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, drafts, draft_rows,
+                       valid_n, key_data, temps, top_ks, top_ps,
+                       _adapter_layers(bank, aids))
+
+        return verify
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+               key_data, temps, top_ks, top_ps):
+        return run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                   key_data, temps, top_ks, top_ps)
+
     return verify
 
 
-def _build_slot_verify_tp(cfg, K, ml, mesh):
+def _build_slot_verify_tp(cfg, K, ml, mesh, adapters=False):
+    tp = cfg.n_tensor_parallel
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
-    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+    H_loc = cfg.n_heads // tp
 
-    def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
-             key_data, temps, top_ks, top_ps):
+    def run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+            key_data, temps, top_ks, top_ps, ab_at=None):
         blocks, embed, head = _tp_local_trees(params)
         xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
         qpos, wpos = _verify_positions(pos, valid_n, K, ml)
         kc, vc, rows = _slot_verify_fwd(blocks, embed, head, kc, vc, xs,
-                                        qpos, wpos, H_loc, tail)
+                                        qpos, wpos, H_loc, tail, ab_at)
         rows = _close_rows(rows)
         toks2, n_acc, kd2 = _spec_accept_rows(
             rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
             top_ps)
         return kc, vc, toks2, n_acc, kd2
 
+    if adapters:
+        def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                 key_data, temps, top_ks, top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, drafts, draft_rows,
+                       valid_n, key_data, temps, top_ks, top_ps,
+                       _tp_adapter_layers(bank, aids, tp))
+
+        return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=11, n_buf_out=2,
+                       n_rest_out=3)
+
+    def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+             key_data, temps, top_ks, top_ps):
+        return run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                   key_data, temps, top_ks, top_ps)
+
     return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=9, n_buf_out=2,
                    n_rest_out=3)
 
 
 def _paged_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wphys, woff,
-                      tables, H, bs, dh, tail, kernel="dense"):
+                      tables, H, bs, dh, tail, kernel="dense", ab_at=None):
     """K-tokens-per-slot verify forward over the paged block pool: scatter
     each position's K/V into ``(wphys, woff)`` (the trash block past the
     budget) and attend the table span, masked per query — via the
@@ -2037,7 +2302,8 @@ def _paged_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wphys, woff,
     live = (jnp.arange(span)[None, None, None, :]
             <= qpos[:, None, :, None])                       # [S,1,K,span]
     for li, bp in enumerate(blocks):
-        q, knew, vnew = _dense_qkv(bp, h, H)                 # [S, H, K, dh]
+        q, knew, vnew = _dense_qkv(                          # [S, H, K, dh]
+            bp, h, H, None if ab_at is None else ab_at(li))
         kc = _paged_scatter(kc, li, wphys, woff, knew.swapaxes(1, 2))
         vc = _paged_scatter(vc, li, wphys, woff, vnew.swapaxes(1, 2))
         if kernel == "fused":
@@ -2056,7 +2322,8 @@ def _paged_verify_fwd(blocks, embed, head, kc, vc, xs, qpos, wphys, woff,
 
 def make_paged_verify_step(stages, cfg: GPTConfig, max_len: int,
                            block_size: int, spec_k: int, cache_dtype=None,
-                           mesh=None, kernel: str = "dense"):
+                           mesh=None, kernel: str = "dense",
+                           adapters: bool = False):
     """Target verify tick (paged layout): ``verify(params, kc, vc,
     toks [S], pos [S], drafts [S, K], draft_rows [S, K, V],
     valid_n [S], tables [S, NB], key_data [S, 2], temps [S], top_ks [S],
@@ -2080,12 +2347,13 @@ def make_paged_verify_step(stages, cfg: GPTConfig, max_len: int,
     _check_attn_kernel(kernel, "make_paged_verify_step")
     H, bs = cfg.n_heads, block_size
     dh = cfg.d_model // H
-    key_ = ("paged_verify", cfg, max_len, block_size, spec_k, mesh, kernel)
+    key_ = ("paged_verify", cfg, max_len, block_size, spec_k, mesh, kernel,
+            adapters)
     if cfg.n_tensor_parallel > 1:
         return _memo_build(key_, lambda: _build_paged_verify_step_tp(
-            cfg, spec_k, max_len, bs, dh, mesh, kernel))
+            cfg, spec_k, max_len, bs, dh, mesh, kernel, adapters))
     return _memo_build(key_, lambda: _build_paged_verify_step(
-        H, spec_k, max_len, bs, dh, kernel))
+        H, spec_k, max_len, bs, dh, kernel, adapters))
 
 
 def _paged_verify_routing(pos, valid_n, tables, K, bs, ml):
@@ -2101,43 +2369,77 @@ def _paged_verify_routing(pos, valid_n, tables, K, bs, ml):
     return qpos, wphys, woff
 
 
-def _build_paged_verify_step(H, K, ml, bs, dh, kernel="dense"):
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
-               tables, key_data, temps, top_ks, top_ps):
+def _build_paged_verify_step(H, K, ml, bs, dh, kernel="dense",
+                             adapters=False):
+    def run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+            tables, key_data, temps, top_ks, top_ps, ab_at=None):
         embed, blocks, head = _merged_stage_trees(params)
         xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
         qpos, wphys, woff = _paged_verify_routing(pos, valid_n, tables, K,
                                                   bs, ml)
         kc, vc, rows = _paged_verify_fwd(blocks, embed, head, kc, vc, xs,
                                          qpos, wphys, woff, tables, H, bs,
-                                         dh, _dense_attn_tail, kernel)
+                                         dh, _dense_attn_tail, kernel,
+                                         ab_at)
         toks2, n_acc, kd2 = _spec_accept_rows(
             rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
             top_ps)
         return kc, vc, toks2, n_acc, kd2
 
+    if adapters:
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                   tables, key_data, temps, top_ks, top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, drafts, draft_rows,
+                       valid_n, tables, key_data, temps, top_ks, top_ps,
+                       _adapter_layers(bank, aids))
+
+        return verify
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def verify(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+               tables, key_data, temps, top_ks, top_ps):
+        return run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                   tables, key_data, temps, top_ks, top_ps)
+
     return verify
 
 
-def _build_paged_verify_step_tp(cfg, K, ml, bs, dh, mesh, kernel="dense"):
+def _build_paged_verify_step_tp(cfg, K, ml, bs, dh, mesh, kernel="dense",
+                                adapters=False):
+    tp = cfg.n_tensor_parallel
     tail = functools.partial(_tp_attn_tail, overlap=cfg.overlap)
-    H_loc = cfg.n_heads // cfg.n_tensor_parallel
+    H_loc = cfg.n_heads // tp
 
-    def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
-             tables, key_data, temps, top_ks, top_ps):
+    def run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+            tables, key_data, temps, top_ks, top_ps, ab_at=None):
         blocks, embed, head = _tp_local_trees(params)
         xs = jnp.concatenate([toks[:, None], drafts[:, :-1]], axis=1)
         qpos, wphys, woff = _paged_verify_routing(pos, valid_n, tables, K,
                                                   bs, ml)
         kc, vc, rows = _paged_verify_fwd(blocks, embed, head, kc, vc, xs,
                                          qpos, wphys, woff, tables, H_loc,
-                                         bs, dh, tail, kernel)
+                                         bs, dh, tail, kernel, ab_at)
         rows = _close_rows(rows)
         toks2, n_acc, kd2 = _spec_accept_rows(
             rows, drafts, draft_rows, valid_n, key_data, temps, top_ks,
             top_ps)
         return kc, vc, toks2, n_acc, kd2
+
+    if adapters:
+        def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                 tables, key_data, temps, top_ks, top_ps, bank, aids):
+            return run(params, kc, vc, toks, pos, drafts, draft_rows,
+                       valid_n, tables, key_data, temps, top_ks, top_ps,
+                       _tp_adapter_layers(bank, aids, tp))
+
+        return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=12, n_buf_out=2,
+                       n_rest_out=3)
+
+    def body(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+             tables, key_data, temps, top_ks, top_ps):
+        return run(params, kc, vc, toks, pos, drafts, draft_rows, valid_n,
+                   tables, key_data, temps, top_ks, top_ps)
 
     return _tp_jit(body, mesh, n_buf_in=2, n_rest_in=10, n_buf_out=2,
                    n_rest_out=3)
@@ -2158,7 +2460,7 @@ def _check_spec_tick_build(cfg: GPTConfig, draft_cfg: GPTConfig,
 
 def make_slot_spec_tick(stages, cfg: GPTConfig, draft_stages,
                         draft_cfg: GPTConfig, max_len: int, spec_k: int,
-                        cache_dtype=None):
+                        cache_dtype=None, adapters: bool = False):
     """The FUSED speculative tick (dense layout, single-device targets):
     ``tick(dparams, dkc, dvc, params, kc, vc, toks [S], pos [S],
     valid_n [S], draft_key_data [S, 2], key_data [S, 2], temps [S],
@@ -2171,34 +2473,54 @@ def make_slot_spec_tick(stages, cfg: GPTConfig, draft_stages,
     output (they flow straight into the acceptance test inside the fused
     program). Exactly :func:`make_slot_propose` composed with
     :func:`make_slot_verify_step`, so the greedy bit-exactness contract
-    carries over unchanged. All four pool buffers are donated."""
+    carries over unchanged. All four pool buffers are donated.
+
+    With ``adapters=True`` the tick takes trailing ``(bank, aids)`` and
+    forwards them to the VERIFY side only: the draft proposer stays the
+    base model (a wrong proposal only costs acceptance rate, never
+    correctness — verify's adapted rows decide every emitted token)."""
     _check_spec_tick_build(cfg, draft_cfg, "make_slot_spec_tick")
     propose = make_slot_propose(draft_stages, draft_cfg, max_len, spec_k,
                                 cache_dtype)
     verify = make_slot_verify_step(stages, cfg, max_len, spec_k,
-                                   cache_dtype)
+                                   cache_dtype, adapters=adapters)
 
     def build():
-        @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
-        def tick(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
-                 dkd, kd, temps, top_ks, top_ps):
+        def run(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
+                dkd, kd, temps, top_ks, top_ps, extra=()):
             dkc, dvc, drafts, qrows, dkd2 = propose(
                 dparams, dkc, dvc, toks, pos, dkd, temps, top_ks, top_ps)
             kc, vc, otoks, nacc, kd2 = verify(
                 params, kc, vc, toks, pos, drafts, qrows, valid_n, kd,
-                temps, top_ks, top_ps)
+                temps, top_ks, top_ps, *extra)
             return dkc, dvc, kc, vc, otoks, nacc, kd2, dkd2
+
+        if adapters:
+            @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
+            def tick(dparams, dkc, dvc, params, kc, vc, toks, pos,
+                     valid_n, dkd, kd, temps, top_ks, top_ps, bank, aids):
+                return run(dparams, dkc, dvc, params, kc, vc, toks, pos,
+                           valid_n, dkd, kd, temps, top_ks, top_ps,
+                           (bank, aids))
+
+            return tick
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
+        def tick(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
+                 dkd, kd, temps, top_ks, top_ps):
+            return run(dparams, dkc, dvc, params, kc, vc, toks, pos,
+                       valid_n, dkd, kd, temps, top_ks, top_ps)
 
         return tick
 
-    return _memo_build(("slot_spec_tick", cfg, draft_cfg, max_len, spec_k),
-                       build)
+    return _memo_build(("slot_spec_tick", cfg, draft_cfg, max_len, spec_k,
+                        adapters), build)
 
 
 def make_paged_spec_tick(stages, cfg: GPTConfig, draft_stages,
                          draft_cfg: GPTConfig, max_len: int,
                          block_size: int, spec_k: int, cache_dtype=None,
-                         kernel: str = "dense"):
+                         kernel: str = "dense", adapters: bool = False):
     """Paged twin of :func:`make_slot_spec_tick`: ``tick(dparams, dkc,
     dvc, params, kc, vc, toks, pos, valid_n, tables [S, NB], dkd, kd,
     temps, top_ks, top_ps) -> (dkc, dvc, kc, vc, toks [S, K], n_acc [S],
@@ -2214,23 +2536,40 @@ def make_paged_spec_tick(stages, cfg: GPTConfig, draft_stages,
     propose = make_slot_propose(draft_stages, draft_cfg, max_len, spec_k,
                                 draft_cd)
     verify = make_paged_verify_step(stages, cfg, max_len, block_size,
-                                    spec_k, cache_dtype, kernel=kernel)
+                                    spec_k, cache_dtype, kernel=kernel,
+                                    adapters=adapters)
 
     def build():
-        @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
-        def tick(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
-                 tables, dkd, kd, temps, top_ks, top_ps):
+        def run(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
+                tables, dkd, kd, temps, top_ks, top_ps, extra=()):
             dkc, dvc, drafts, qrows, dkd2 = propose(
                 dparams, dkc, dvc, toks, pos, dkd, temps, top_ks, top_ps)
             kc, vc, otoks, nacc, kd2 = verify(
                 params, kc, vc, toks, pos, drafts, qrows, valid_n,
-                tables, kd, temps, top_ks, top_ps)
+                tables, kd, temps, top_ks, top_ps, *extra)
             return dkc, dvc, kc, vc, otoks, nacc, kd2, dkd2
+
+        if adapters:
+            @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
+            def tick(dparams, dkc, dvc, params, kc, vc, toks, pos,
+                     valid_n, tables, dkd, kd, temps, top_ks, top_ps,
+                     bank, aids):
+                return run(dparams, dkc, dvc, params, kc, vc, toks, pos,
+                           valid_n, tables, dkd, kd, temps, top_ks,
+                           top_ps, (bank, aids))
+
+            return tick
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 4, 5))
+        def tick(dparams, dkc, dvc, params, kc, vc, toks, pos, valid_n,
+                 tables, dkd, kd, temps, top_ks, top_ps):
+            return run(dparams, dkc, dvc, params, kc, vc, toks, pos,
+                       valid_n, tables, dkd, kd, temps, top_ks, top_ps)
 
         return tick
 
     return _memo_build(("paged_spec_tick", cfg, draft_cfg, max_len,
-                        block_size, spec_k, kernel), build)
+                        block_size, spec_k, kernel, adapters), build)
 
 
 # The memoized decode-path builders, by name — the single list the
@@ -2245,6 +2584,7 @@ DECODE_BUILDERS = {
     "make_paged_prefill_chunk": make_paged_prefill_chunk,
     "make_paged_decode_step": make_paged_decode_step,
     "make_paged_block_copy": make_paged_block_copy,
+    "make_adapter_bank_update": make_adapter_bank_update,
     "make_slot_propose": make_slot_propose,
     "make_slot_verify_step": make_slot_verify_step,
     "make_paged_verify_step": make_paged_verify_step,

@@ -1,0 +1,158 @@
+"""Low-rank adapters (LoRA) over the GPT stage weights — multi-tenant
+serving's per-tenant model deltas (ISSUE 20).
+
+One *adapter* is a per-layer pair of low-rank factors for each adapted
+projection: the served model computes ``q = hn @ wq + (hn @ aq) @ bq``
+(same for ``v``) — the base weights are NEVER mutated, and the delta's
+rank ``r`` is tiny next to ``d_model``, so hundreds of tenant fine-tunes
+share one resident copy of the base model. The adapted projections are
+the classic LoRA targets, attention's query and value (``wq``/``wv``);
+``B`` initializes to zero so a fresh adapter IS the base model.
+
+Serving applies adapters *merge-free and batched*: the engine stacks the
+resident adapters into per-matrix BANKS with a leading adapter-row axis
+(:func:`stack_adapters`), and every decode-path program gathers each
+slot's A/B rows by a per-slot adapter index — the same discipline as the
+per-slot traced sampling params, so ONE compiled program serves any
+adapter mix per tick and a hot-swap (bank row rewrite) never retraces.
+Row 0 is the all-zero BASE row: a request without an adapter gathers
+exact zero deltas, and ``x + 0.0`` keeps its token stream identical to
+an engine with no adapter subsystem at all.
+
+The correctness anchor is the MERGED form: :func:`merge_adapter` bakes
+``W + A @ B`` densely into a copy of the stage weights, and a solo
+engine on those merged weights must emit the tenant's exact token stream
+(tests/test_adapters.py pins it across mixed-adapter ticks, hot-swap,
+preemption and crash recovery).
+
+:func:`bank_bytes` is the ONE adapter HBM formula — the AdapterStore's
+``serve_adapter_resident_bytes`` gauge and the analyzer's
+``predict_adapter_bytes`` both call it, which is what makes the
+live-gauge parity pin exact by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: the adapted projections (classic LoRA: attention query + value); the
+#: bank carries one (A, B) pair per target per layer
+LORA_TARGETS = ("wq", "wv")
+
+#: bank keys in gather order: A then B for each target
+BANK_KEYS = ("aq", "bq", "av", "bv")
+
+
+def _check_rank(d_model: int, rank: int) -> None:
+    if not 1 <= rank <= d_model:
+        raise ValueError(
+            f"adapter rank {rank} outside [1, d_model={d_model}] — a rank "
+            f"above d_model is no longer LOW-rank (and wastes the bank)")
+
+
+def init_lora_adapter(key: jax.Array, cfg, rank: int,
+                      a_std: float = 0.02) -> dict:
+    """One adapter's weights: ``{"aq": [L, d, r], "bq": [L, r, d],
+    "av": [L, d, r], "bv": [L, r, d]}`` (f32, L = ``cfg.n_layers``).
+
+    Standard LoRA init: A gaussian (``a_std``), B zero — the fresh
+    adapter's delta is exactly 0, i.e. the base model. Train or perturb B
+    to make the adapter DO something (the tests use small random B)."""
+    _check_rank(cfg.d_model, rank)
+    ka, kv = jax.random.split(key)
+    L, d = cfg.n_layers, cfg.d_model
+    return {
+        "aq": a_std * jax.random.normal(ka, (L, d, rank), jnp.float32),
+        "bq": jnp.zeros((L, rank, d), jnp.float32),
+        "av": a_std * jax.random.normal(kv, (L, d, rank), jnp.float32),
+        "bv": jnp.zeros((L, rank, d), jnp.float32),
+    }
+
+
+def zero_adapter(cfg, rank: int) -> dict:
+    """The all-zero adapter — bank row 0, the base model's identity
+    delta. Kept as a function (not a constant) so shape always matches
+    the deployment's (n_layers, d_model, rank)."""
+    _check_rank(cfg.d_model, rank)
+    L, d = cfg.n_layers, cfg.d_model
+    return {"aq": jnp.zeros((L, d, rank), jnp.float32),
+            "bq": jnp.zeros((L, rank, d), jnp.float32),
+            "av": jnp.zeros((L, d, rank), jnp.float32),
+            "bv": jnp.zeros((L, rank, d), jnp.float32)}
+
+
+def check_adapter_shapes(adapter: dict, cfg, rank: int) -> None:
+    """Validate one adapter tree against a deployment's (L, d, r) —
+    loud host-side rejection instead of a shape error mid-upload."""
+    L, d = cfg.n_layers, cfg.d_model
+    want = {"aq": (L, d, rank), "bq": (L, rank, d),
+            "av": (L, d, rank), "bv": (L, rank, d)}
+    for k in BANK_KEYS:
+        if k not in adapter:
+            raise ValueError(f"adapter tree missing key {k!r} "
+                             f"(want keys {BANK_KEYS})")
+        got = tuple(adapter[k].shape)
+        if got != want[k]:
+            raise ValueError(
+                f"adapter[{k!r}] shape {got} != {want[k]} for "
+                f"n_layers={L}, d_model={d}, rank={rank}")
+
+
+def stack_adapters(adapters: list) -> dict:
+    """Stack adapter trees into the device BANK the decode programs
+    gather from: leaf ``[N, L, ...]`` where row i is ``adapters[i]``.
+    Row 0 should be :func:`zero_adapter` (the AdapterStore enforces
+    it)."""
+    if not adapters:
+        raise ValueError("stack_adapters needs at least the base row")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *adapters)
+
+
+def lora_delta(hn: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
+    """The merge-free low-rank apply: ``(hn @ a) @ b``.
+
+    ONE expression for both serving shapes — ``jnp.matmul`` broadcasting
+    covers the unbatched prefill case (``hn [1, T, d]``, ``a [d, r]``,
+    one request's adapter) and the batched decode case (``hn [S, K, d]``,
+    ``a [S, d, r]``, each slot's own gathered adapter) — so the prefill
+    and tick programs can never drift apart on the delta math."""
+    return jnp.matmul(jnp.matmul(hn, a), b)
+
+
+def merge_adapter(params_list: list, adapter: dict) -> list:
+    """The MERGED-DENSE twin: stage param trees with ``W + A @ B`` baked
+    into every block's ``wq``/``wv`` — what a dedicated single-tenant
+    engine would serve. The bit-exactness anchor: a tenant's token
+    stream through the batched adapter path must equal a solo engine on
+    these merged weights. Layer index runs GLOBALLY across the stage
+    split (block ``li`` pairs with ``adapter[...][li]``), matching the
+    bank's layer axis. Non-mutating: returns new trees, shares
+    everything but the adapted matrices."""
+    li = 0
+    out = []
+    for p in params_list:
+        np_ = dict(p)
+        blocks = []
+        for bp in p["blocks"]:
+            nb = dict(bp)
+            attn = dict(bp["attn"])
+            attn["wq"] = (attn["wq"]
+                          + adapter["aq"][li] @ adapter["bq"][li])
+            attn["wv"] = (attn["wv"]
+                          + adapter["av"][li] @ adapter["bv"][li])
+            nb["attn"] = attn
+            blocks.append(nb)
+            li += 1
+        np_["blocks"] = blocks
+        out.append(np_)
+    return out
+
+
+def bank_bytes(n_rows: int, n_layers: int, d_model: int, rank: int) -> int:
+    """HBM bytes one resident adapter bank pins: ``n_rows`` adapters x
+    ``n_layers`` x (aq + bq + av + bv = 4 * d * r f32 values). The ONE
+    formula — the AdapterStore's ``serve_adapter_resident_bytes`` gauge
+    and ``analysis/programs.py::predict_adapter_bytes`` both call it, so
+    the analyzer-vs-live parity pin is exact by construction."""
+    return int(n_rows) * int(n_layers) * 4 * int(d_model) * int(rank) * 4

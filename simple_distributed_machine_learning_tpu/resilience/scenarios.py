@@ -70,6 +70,15 @@ The catalog (also in docs/ARCHITECTURE.md):
                      scales out, the idle trough drains-then-retires —
                      the exact virtual-clock replica-count trajectory
                      (``ServeFleet.replica_log``) is pinned in tests
+``hot-adapter-churn`` two LoRA tenants over a 3-replica fleet with one
+                     tenant's weights hot-swapped mid-run under load
+                     (``serve/adapters.py``): adapter-affinity routing
+                     concentrates each tenant on a resident replica, the
+                     swap re-uploads without a retrace and old-version
+                     prefix K/V is orphaned — the gate requires ALL
+                     requests complete AND ≥ 3 bank uploads happened;
+                     tests pin affinity's adapter-affinity hits STRICTLY
+                     above round-robin's on this exact workload
 =================== =====================================================
 
 Supervised scenarios (``Scenario.supervised``) run through the
@@ -183,6 +192,17 @@ class Scenario:
     prefetch_ticks: int = 1
     min_host_demotes: int = 0
     min_host_prefetch_hits: int = 0
+    # multi-tenant LoRA serving (ISSUE 20): adapter_rank > 0 builds every
+    # engine with an AdapterStore of that rank and registers `adapters`
+    # (deterministic seeded weights per name) on the target before
+    # traffic; adapter_swap_tick re-registers adapters[0] with NEW seeded
+    # weights at that target tick — the hot-swap-under-load move — and
+    # min_adapter_swaps is the vacuous-pass gate (a churn scenario whose
+    # bank never uploaded must FAIL, not pass by doing nothing)
+    adapter_rank: int = 0
+    adapters: tuple = ()
+    adapter_swap_tick: int = 0
+    min_adapter_swaps: int = 0
 
     def __post_init__(self):
         if self.scheduler not in ("fcfs", "priority"):
@@ -227,6 +247,20 @@ class Scenario:
                 "min_host_demotes/min_host_prefetch_hits need "
                 "host_cache_blocks > 0 (only the host offload tier "
                 "demotes and prefetches)")
+        if self.adapter_rank:
+            if not (self.supervised or self.replicas):
+                raise ValueError(
+                    "adapter_rank needs supervised=True or a fleet (the "
+                    "engine factory builds the AdapterStore)")
+            if not self.adapters:
+                raise ValueError(
+                    "adapter_rank > 0 needs at least one tenant name in "
+                    "`adapters`")
+        elif (self.adapters or self.adapter_swap_tick
+              or self.min_adapter_swaps):
+            raise ValueError(
+                "adapters/adapter_swap_tick/min_adapter_swaps need "
+                "adapter_rank > 0")
 
 
 # SLO targets are VIRTUAL milliseconds (see module docstring): an engine
@@ -431,6 +465,33 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
         replicas=1, host_cache_blocks=12, prefetch_ticks=1,
         min_host_demotes=1, min_host_prefetch_hits=1),
     Scenario(
+        name="hot-adapter-churn",
+        description="two LoRA tenants' traffic over a 3-replica fleet "
+                    "with one tenant's weights hot-swapped mid-run under "
+                    "load: adapter-affinity routing keeps each tenant on "
+                    "a replica already holding its bank row (round-robin "
+                    "stays adapter-blind and re-uploads per landing), the "
+                    "swap lands at a tick boundary without a retrace, and "
+                    "the swapped tenant's later requests decode the NEW "
+                    "weights (gates: all complete AND >= 3 bank uploads "
+                    "actually happened; tests pin affinity's "
+                    "adapter-affinity hit counter strictly above "
+                    "round-robin's on this exact workload)",
+        sim=SimConfig(n_requests=18, rate=16.0, seed=0,
+                      classes=(
+                          dataclasses.replace(
+                              _INTERACTIVE, name="tenant-a", weight=0.5,
+                              ttft_slo_ms=None, tpot_slo_ms=None,
+                              adapter="tenant-a"),
+                          dataclasses.replace(
+                              _INTERACTIVE, name="tenant-b", weight=0.5,
+                              ttft_slo_ms=None, tpot_slo_ms=None,
+                              adapter="tenant-b"))),
+        n_slots=2, prefill_chunk=4, scheduler="fcfs",
+        replicas=3, route="affinity",
+        adapter_rank=2, adapters=("tenant-a", "tenant-b"),
+        adapter_swap_tick=6, min_adapter_swaps=3),
+    Scenario(
         name="handoff-replica-loss",
         description="disaggregated fleet (1 prefill + 2 decode) with a "
                     "DECODE replica killed while handoffs are in flight: "
@@ -567,6 +628,8 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
         if n_host:
             engine_kw["host_cache_blocks"] = n_host
             engine_kw["prefetch_ticks"] = scenario.prefetch_ticks
+        if scenario.adapter_rank:
+            engine_kw["adapter_rank"] = scenario.adapter_rank
         if trace and not (sup_flag or fleet_flag):
             engine_kw["trace"] = trace
         if fleet_flag:
@@ -620,6 +683,36 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                 postmortem_dir=outdir, slo=slo_engine)
         else:
             target = InferenceEngine(stages, cfg, **engine_kw)
+        if scenario.adapter_rank:
+            # deterministic tenants: weights are a pure function of
+            # (tenant index, cfg, rank), so the virtual-clock run's token
+            # streams — and every pinned number — reproduce exactly
+            import jax
+
+            from simple_distributed_machine_learning_tpu.models import (
+                lora,
+            )
+            for k, name in enumerate(scenario.adapters):
+                target.register_adapter(name, lora.init_lora_adapter(
+                    jax.random.key(1000 + k), cfg, scenario.adapter_rank))
+            if scenario.adapter_swap_tick:
+                # swap-under-load: at target tick N, re-register the
+                # first tenant with NEW seeded weights. Tick counting
+                # reads no clock, so the virtual timeline is identical
+                # with the swap armed or not.
+                swap_name = scenario.adapters[0]
+                new_w = lora.init_lora_adapter(jax.random.key(424242),
+                                               cfg, scenario.adapter_rank)
+                inner_step = target.step
+                state = {"n": 0}
+
+                def step():
+                    state["n"] += 1
+                    if state["n"] == scenario.adapter_swap_tick:
+                        target.register_adapter(swap_name, new_w)
+                    return inner_step()
+
+                target.step = step
         report = simulate(target, scenario.sim, sleep=sleep)
     finally:
         if plan is not None:
@@ -688,6 +781,15 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
                >= scenario.min_host_demotes)
         ok &= (report["host_tier"]["prefetch_hits"]
                >= scenario.min_host_prefetch_hits)
+    if scenario.adapter_rank:
+        report["adapters"] = {
+            "rank": scenario.adapter_rank,
+            "tenants": list(scenario.adapters),
+            "resident_bytes": int(metrics.adapter_resident_bytes.value),
+            "swaps": int(metrics.adapter_swaps.value),
+            "adapter_affinity_hits": int(metrics.route_adapter_hits.value),
+        }
+        ok &= report["adapters"]["swaps"] >= scenario.min_adapter_swaps
     if trace:
         report["trace_events"] = trace.n_events
         # fold every traced request's timeline into the additive TTFT
@@ -735,6 +837,8 @@ def run_scenario(scenario: Scenario | str, stages, cfg, *,
             **({"fleet": {k: v for k, v in report["fleet"].items()
                           if k != "replica_log"}} if fleet_flag else {}),
             **({"host_tier": report["host_tier"]} if n_host else {}),
+            **({"adapters": report["adapters"]}
+               if scenario.adapter_rank else {}),
             **({"slo_alerts": {
                 "transitions": len(slo_engine.alerts.journal),
                 "firing": slo_engine.active_alerts(),

@@ -134,6 +134,19 @@ class InferenceEngine:
     solo decode (the models/gpt.py speculative-section contract). The
     draft keeps its own dense slot-pool K/V buffers and per-request key
     stream regardless of the target layout.
+
+    Multi-tenant adapters: pass ``adapters`` (a
+    :class:`~.adapters.AdapterStore` built for this engine's ``n_slots``)
+    and every decode-path program is built with trailing adapter-bank
+    args — each slot gathers its adapter's low-rank rows by a per-slot
+    index, so one compiled program serves any adapter mix per tick and a
+    hot-swap never retraces. ``submit(..., adapter="tenant")`` pins a
+    request to a registered adapter; ``adapter=None`` rides bank row 0
+    (the all-zero base row — its stream is identical to an engine with
+    no adapter subsystem). The admission gate uploads/refcounts bank
+    rows at tick boundaries; the paged prefix cache is namespaced per
+    adapter so tenants can never share K/V computed under a different
+    model.
     """
 
     def __init__(self, stages, cfg, *, params=None, n_slots: int = 4,
@@ -146,7 +159,8 @@ class InferenceEngine:
                  scheduler: FCFSScheduler | None = None,
                  clock=time.monotonic, lint: bool = False,
                  mesh=None, draft_stages=None, draft_cfg=None,
-                 spec_k: int = 0, trace=None, flight=None) -> None:
+                 spec_k: int = 0, trace=None, flight=None,
+                 adapters=None) -> None:
         from simple_distributed_machine_learning_tpu.models.gpt import (
             make_paged_block_copy,
             make_paged_decode_step,
@@ -198,6 +212,12 @@ class InferenceEngine:
             raise ValueError(
                 f"spec_k={spec_k} without draft_stages/draft_cfg — the "
                 f"draft model is what proposes the speculated tokens")
+        if adapters is not None and adapters.n_rows != n_slots + 1:
+            raise ValueError(
+                f"AdapterStore has {adapters.n_rows} bank rows but this "
+                f"engine needs n_slots + 1 = {n_slots + 1} (base row + one "
+                f"per slot — the never-refuse sizing)")
+        self._adapters = adapters
         self.cfg = cfg
         self.stages = stages       # kept for the analyzer's program registry
         self.kv_layout = kv_layout
@@ -214,6 +234,7 @@ class InferenceEngine:
         self.draft_cfg = draft_cfg
         n_layers = sum(len(p["blocks"]) for p in self.params)
         head_dim = cfg.d_model // cfg.n_heads
+        adp = adapters is not None
         if kv_layout == "paged":
             self.pool = PagedKVPool(n_layers, n_slots, cfg.n_heads,
                                     self.max_len, head_dim, cache_dtype,
@@ -223,27 +244,30 @@ class InferenceEngine:
                                     prefetch_ticks=prefetch_ticks)
             self._chunk_prefill = make_paged_prefill_chunk(
                 stages, cfg, self.max_len, block_size, cache_dtype,
-                mesh=mesh)
+                mesh=mesh, adapters=adp)
             self._decode = make_paged_decode_step(
                 stages, cfg, self.max_len, block_size, cache_dtype,
-                mesh=mesh, kernel=attn_kernel)
+                mesh=mesh, kernel=attn_kernel, adapters=adp)
             self._copy_block = make_paged_block_copy()
             if self.speculative:
                 self._verify = make_paged_verify_step(
                     stages, cfg, self.max_len, block_size, spec_k,
-                    cache_dtype, mesh=mesh, kernel=attn_kernel)
+                    cache_dtype, mesh=mesh, kernel=attn_kernel,
+                    adapters=adp)
         else:
             self.pool = KVCachePool(n_layers, n_slots, cfg.n_heads,
                                     self.max_len, head_dim, cache_dtype,
                                     tp=self.tp)
             self._prefill = make_slot_prefill(stages, cfg, self.max_len,
-                                              cache_dtype, mesh=mesh)
+                                              cache_dtype, mesh=mesh,
+                                              adapters=adp)
             self._decode = make_slot_decode_step(stages, cfg, self.max_len,
-                                                 cache_dtype, mesh=mesh)
+                                                 cache_dtype, mesh=mesh,
+                                                 adapters=adp)
             if self.speculative:
                 self._verify = make_slot_verify_step(
                     stages, cfg, self.max_len, spec_k, cache_dtype,
-                    mesh=mesh)
+                    mesh=mesh, adapters=adp)
         if self.speculative:
             if draft_cfg.vocab != cfg.vocab:
                 raise ValueError(
@@ -271,11 +295,11 @@ class InferenceEngine:
                     make_paged_spec_tick(stages, cfg, draft_stages,
                                          draft_cfg, self.max_len,
                                          block_size, spec_k, cache_dtype,
-                                         kernel=attn_kernel)
+                                         kernel=attn_kernel, adapters=adp)
                     if kv_layout == "paged" else
                     make_slot_spec_tick(stages, cfg, draft_stages,
                                         draft_cfg, self.max_len, spec_k,
-                                        cache_dtype))
+                                        cache_dtype, adapters=adp))
             else:
                 # a TP target verifies in a shard_map program while the
                 # draft stays replicated single-device — two dispatches
@@ -399,7 +423,8 @@ class InferenceEngine:
                on_token=None, arrival_time: float | None = None,
                cls: str | None = None, priority: int = 0,
                ttft_deadline_s: float | None = None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               adapter: str | None = None) -> Request:
         """Enqueue one request; returns its live handle immediately.
 
         ``arrival_time`` backdates ``submit_time`` to when the request
@@ -424,6 +449,7 @@ class InferenceEngine:
                         ("deadline_s", deadline_s)):
             if v is not None and v <= 0:
                 raise ValueError(f"{name} must be > 0, got {v}")
+        self._check_adapter(adapter)
         rid = self._next_rid
         self._next_rid += 1
         seed = rid if seed is None else seed
@@ -431,7 +457,13 @@ class InferenceEngine:
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     eos_id=eos_id, seed=seed, on_token=on_token,
                     cls=cls, priority=priority,
-                    ttft_deadline_s=ttft_deadline_s, deadline_s=deadline_s)
+                    ttft_deadline_s=ttft_deadline_s, deadline_s=deadline_s,
+                    adapter=adapter)
+        if self._adapters is not None:
+            # the version-qualified prefix-cache namespace (refreshed at
+            # the admission gate — the probe and the decode must agree on
+            # the adapter VERSION or a hot-swap could reuse stale K/V)
+            r._prefix_ns = self._adapters.namespace_of(adapter)
         # the request's independent key stream — the SAME key a solo
         # make_cached_decoder call would be handed, so streams align
         r.key_data = np.asarray(jax.random.key_data(jax.random.key(seed)))
@@ -452,6 +484,75 @@ class InferenceEngine:
         if self.trace is not None:
             self.trace.on_submit(r, r.submit_time)
         return r
+
+    # -- adapter plumbing --------------------------------------------------
+
+    def register_adapter(self, name: str, weights: dict) -> None:
+        """Add or hot-swap a named LoRA adapter (host-side only; the
+        device row uploads at the next admission). Same call shape as
+        :meth:`ServeSupervisor.register_adapter` /
+        :meth:`ServeFleet.register_adapter`, so callers can target any
+        serving tier uniformly."""
+        if self._adapters is None:
+            raise ValueError("this engine was built without an "
+                             "AdapterStore — pass adapters= at "
+                             "construction")
+        self._adapters.register(name, weights)
+
+    def _check_adapter(self, adapter: str | None) -> None:
+        if adapter is None:
+            return
+        if self._adapters is None:
+            raise ValueError(
+                f"request names adapter {adapter!r} but this engine was "
+                f"built without an AdapterStore — pass adapters= at "
+                f"construction")
+        if not self._adapters.is_registered(adapter):
+            raise KeyError(
+                f"adapter {adapter!r} is not registered "
+                f"(known: {list(self._adapters.names())})")
+
+    def _adapter_board(self, r: Request) -> bool:
+        """The scheduler's admission gate: pin the request's adapter row
+        (uploading at this tick boundary if needed) and take its ref.
+        Structurally never refuses — the bank has one more row than the
+        pool has slots, and admission already holds a free slot."""
+        if getattr(r, "adapter", None) is None or self._adapters is None:
+            r._adapter_row = 0
+            return True
+        # a hot-swap between submit and boarding changes the version this
+        # admission will pin: refresh the prefix namespace (and drop the
+        # stale probe memo) BEFORE bind_seq probes the registry, so the
+        # K/V the request reuses was computed under the version it decodes
+        ns = self._adapters.namespace_of(r.adapter)
+        if getattr(r, "_prefix_ns", None) != ns:
+            r._prefix_ns = ns
+            r._prefix_probe = None
+        r._adapter_row = self._adapters.retain(r.adapter)
+        return True
+
+    def _adapter_release(self, r: Request) -> None:
+        row = getattr(r, "_adapter_row", 0)
+        if row and self._adapters is not None:
+            self._adapters.release(row)
+        r._adapter_row = 0
+
+    def _adapter_inputs(self, active: list[int]) -> np.ndarray:
+        """Per-slot adapter row indices for a batched tick — the same
+        discipline as :meth:`_sampling_inputs` (inactive slots gather the
+        zero base row, whose delta is exactly 0)."""
+        aids = np.zeros(self.pool.n_slots, np.int32)
+        for s in active:
+            r = self.requests[self.pool.occupant(s)]
+            aids[s] = getattr(r, "_adapter_row", 0)
+        return aids
+
+    def _bank_args(self, aids) -> tuple:
+        """The trailing ``(bank, aids)`` program args — empty without a
+        store, so every call site stays a one-splat edit."""
+        if self._adapters is None:
+            return ()
+        return (self._adapters.bank, aids)
 
     def step(self) -> int:
         """One tick; returns the number of tokens emitted. A true no-op
@@ -510,7 +611,9 @@ class InferenceEngine:
                              if self.kv_layout == "paged" else None),
                 tp=self.tp, spec_k=self.spec_k,
                 kv_predicted=predicted, kv_drift=live - predicted,
-                attn_kernel=self.attn_kernel)
+                attn_kernel=self.attn_kernel,
+                adapter_stats=(self._adapters.stats()
+                               if self._adapters is not None else None))
         if self.flight is not None:
             self.flight.snap(self, self._tick_count, emitted)
         return emitted
@@ -570,6 +673,7 @@ class InferenceEngine:
             pass
         self.pool.unbind_seq(r.slot)
         self.pool.release(r.slot)
+        self._adapter_release(r)   # re-acquired (maybe a new row) on re-admit
         r.slot = None
         r.prefill_pos = None
         r.state = QUEUED
@@ -603,6 +707,7 @@ class InferenceEngine:
                 pass
             self.pool.unbind_seq(r.slot)
             self.pool.release(r.slot)
+            self._adapter_release(r)
             r.slot = None
             r.prefill_pos = None
         else:
@@ -641,9 +746,16 @@ class InferenceEngine:
         validate_request(request.prompt, request.max_new_tokens,
                          request.temperature, request.top_k, request.top_p,
                          self.cfg.vocab, self.max_len)
+        self._check_adapter(getattr(request, "adapter", None))
         request.state = QUEUED
         request.slot = None
         request.prefill_pos = None
+        request._adapter_row = 0   # re-acquired at boarding on THIS engine
+        request._prefix_ns = (
+            None if self._adapters is None
+            else self._adapters.namespace_of(
+                getattr(request, "adapter", None)))
+        request._prefix_probe = None   # probed against THIS pool's registry
         if request.key_data is None:
             # never emitted a token: the stream starts where submit's would
             request.key_data = np.asarray(
@@ -688,7 +800,8 @@ class InferenceEngine:
                 seq[None, :], np.int32(r.slot), r.key_data,
                 np.float32(r.temperature),
                 np.int32(r.top_k if r.top_k is not None else _NO_TOP_K),
-                np.float32(r.top_p if r.top_p is not None else _NO_TOP_P))
+                np.float32(r.top_p if r.top_p is not None else _NO_TOP_P),
+                *self._bank_args(np.int32(getattr(r, "_adapter_row", 0))))
             self.pool.kc, self.pool.vc = kc, vc
             if self.speculative:
                 self._draft_prefill_slot(r, seq)
@@ -738,7 +851,8 @@ class InferenceEngine:
         kc, vc, toks, kd2 = self._decode(
             self.params, self.pool.kc, self.pool.vc,
             self.pool.last_token.copy(), self.pool.positions.copy(),
-            kd, temps, top_ks, top_ps)
+            kd, temps, top_ks, top_ps,
+            *self._bank_args(self._adapter_inputs(active)))
         self.pool.kc, self.pool.vc = kc, vc
         return self._emit_decoded(active, toks, kd2)
 
@@ -781,7 +895,8 @@ class InferenceEngine:
             self.pool.device_table(r.slot), r.key_data,
             np.float32(r.temperature),
             np.int32(r.top_k if r.top_k is not None else _NO_TOP_K),
-            np.float32(r.top_p if r.top_p is not None else _NO_TOP_P))
+            np.float32(r.top_p if r.top_p is not None else _NO_TOP_P),
+            *self._bank_args(np.int32(getattr(r, "_adapter_row", 0))))
         self.pool.kc, self.pool.vc = kc, vc
         tok = int(np.asarray(tok))     # host sync: honest chunk timing
         now = self._now = self._clock()
@@ -860,7 +975,8 @@ class InferenceEngine:
             toks[s] = self.pool.last_token[s]
         kc, vc, toks2, kd2 = self._decode(
             self.params, self.pool.kc, self.pool.vc,
-            toks, pos, tables, kd, temps, top_ks, top_ps)
+            toks, pos, tables, kd, temps, top_ks, top_ps,
+            *self._bank_args(self._adapter_inputs(active)))
         self.pool.kc, self.pool.vc = kc, vc
         return self._emit_decoded(active, toks2, kd2)
 
@@ -923,13 +1039,17 @@ class InferenceEngine:
             for s in active:
                 self._ensure_writable_range(s, int(pos[s]), int(valid[s]))
                 tables[s] = self.pool.device_table(s)
+        # adapters ride the VERIFY side only: the draft proposes as the
+        # base model (a wrong proposal costs acceptance rate, never
+        # correctness — the adapted verify rows decide every emission)
+        bank_args = self._bank_args(self._adapter_inputs(active))
         if self._spec_fused is not None:
             args = (toks, pos, valid) + (() if tables is None
                                          else (tables,))
             dkc, dvc, kc, vc, otoks, nacc, kd2, dkd2 = self._spec_fused(
                 self._draft_params, self._dkc, self._dvc, self.params,
                 self.pool.kc, self.pool.vc, *args, dkd, kd, temps,
-                top_ks, top_ps)
+                top_ks, top_ps, *bank_args)
         else:
             dkc, dvc, drafts, qrows, dkd2 = self._propose(
                 self._draft_params, self._dkc, self._dvc, toks, pos, dkd,
@@ -942,11 +1062,12 @@ class InferenceEngine:
                 kc, vc, otoks, nacc, kd2 = self._verify(
                     self.params, self.pool.kc, self.pool.vc, toks, pos,
                     drafts, qrows, valid, tables, kd, temps, top_ks,
-                    top_ps)
+                    top_ps, *bank_args)
             else:
                 kc, vc, otoks, nacc, kd2 = self._verify(
                     self.params, self.pool.kc, self.pool.vc, toks, pos,
-                    drafts, qrows, valid, kd, temps, top_ks, top_ps)
+                    drafts, qrows, valid, kd, temps, top_ks, top_ps,
+                    *bank_args)
         self._dkc, self._dvc = dkc, dvc
         self.pool.kc, self.pool.vc = kc, vc
         return self._emit_spec(active, otoks, nacc, kd2, dkd2, valid)
@@ -1052,5 +1173,7 @@ class InferenceEngine:
             # blocks — registered ones stay reclaimable — and return the
             # unused reservation) before the slot frees
             self.scheduler.retire(r, reason)
+        self._adapter_release(r)
         if self.metrics is not None:
-            self.metrics.on_complete(cls=r.cls)
+            self.metrics.on_complete(cls=r.cls,
+                                     adapter=getattr(r, "adapter", None))

@@ -385,11 +385,17 @@ class ServeFleet:
                on_token=None, arrival_time: float | None = None,
                cls: str | None = None, priority: int = 0,
                ttft_deadline_s: float | None = None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               adapter: str | None = None) -> Request:
         """Route one submission to an in-rotation replica (affinity first,
         least-loaded fallback — ``serve/router.py``) and submit through
         its supervisor: journaled, admission-controlled, deadline-bound
-        exactly as a single supervised engine would."""
+        exactly as a single supervised engine would. ``adapter`` names
+        the request's tenant (:meth:`register_adapter`): prefix probes
+        scope to its namespace and the router prefers a replica where the
+        adapter is already device-resident — falling back to least-loaded
+        plus an upload at the destination's admission tick, never
+        refusing."""
         if arrival_time is not None:
             self._now = max(self._now, arrival_time)
             self._retire_idle()   # idle troughs advance via arrivals, not
@@ -404,10 +410,13 @@ class ServeFleet:
         else:
             candidates = self._rotation() or self._alive()
         rep, hit = self.router.route(prompt, candidates,
-                                     demoted=frozenset(self._alert_demoted))
+                                     demoted=frozenset(self._alert_demoted),
+                                     adapter=adapter)
         if self.metrics is not None:
             if hit:
                 self.metrics.on_affinity_hit()
+            if self.router.last_adapter_hit:
+                self.metrics.on_adapter_affinity_hit()
             if self.router.last_suppressed:
                 self.metrics.on_alert_demotion()
         # the router knows the prefix BEFORE admission: if a host-tier
@@ -416,7 +425,8 @@ class ServeFleet:
         # serializing in front of the decode
         self._prefetch_host(
             prompt,
-            self._role_alive("decode") if self.disaggregated else [rep])
+            self._role_alive("decode") if self.disaggregated else [rep],
+            adapter=adapter)
         rid = self._next_rid
         rep.supervisor.engine._next_rid = rid
         self._user_cb[rid] = on_token
@@ -430,7 +440,7 @@ class ServeFleet:
                 top_k=top_k, top_p=top_p, eos_id=eos_id, seed=seed,
                 on_token=on_token, arrival_time=arrival_time, cls=cls,
                 priority=priority, ttft_deadline_s=ttft_deadline_s,
-                deadline_s=deadline_s)
+                deadline_s=deadline_s, adapter=adapter)
         except RestartBudgetExceeded as e:
             # an admission crash (serve.admit site) with the replica's
             # restart budget already spent: a replica LOSS, not a fleet
@@ -447,6 +457,17 @@ class ServeFleet:
         self.requests[h.rid] = h
         self._home[h.rid] = rep.idx
         return h
+
+    def register_adapter(self, name: str, weights: dict) -> None:
+        """Register (or hot-swap) a named LoRA adapter on EVERY alive
+        replica — a tenant must be servable wherever routing lands it
+        (and wherever a loss migration re-admits it). The factory's one
+        shared host dict already propagates the weights to future spawns
+        and crash rebuilds; this loop is what bumps each replica's store
+        VERSION so a hot-swap invalidates resident rows and cached
+        prefixes fleet-wide."""
+        for rep in self._alive():
+            rep.supervisor.register_adapter(name, weights)
 
     def step(self) -> int:
         """One fleet tick: interpret scheduled replica-kill faults, step
@@ -514,21 +535,26 @@ class ServeFleet:
 
     # -- disaggregation: routing-time prefetch + end-of-prefill handoff ------
 
-    def _prefetch_host(self, prompt, candidates: list) -> None:
+    def _prefetch_host(self, prompt, candidates: list,
+                       adapter: str | None = None) -> None:
         """Start the async host→HBM upload of the longest host-resident
         prefix among ``candidates`` — only where the host copy strictly
         beats what that replica's pool already holds in HBM (uploading a
         prefix the registry already serves would waste the free blocks).
+        Probes and uploads scope to the request's adapter namespace.
         Pools without a host tier answer 0 everywhere, so symmetric
         HBM-only fleets take this path as a no-op."""
-        best, best_len = None, 0
+        best, best_len, best_ns = None, 0, b""
         for r in candidates:
             pool = r.supervisor.pool
-            n = pool.host_prefix_len(prompt)
-            if n > pool.shared_prefix_len(prompt) and n > best_len:
-                best, best_len = r, n
+            ns, _ = FleetRouter._adapter_state(r, adapter)
+            if ns is None:
+                continue
+            n = pool.host_prefix_len(prompt, ns)
+            if n > pool.shared_prefix_len(prompt, ns) and n > best_len:
+                best, best_len, best_ns = r, n, ns
         if best is not None:
-            best.supervisor.pool.prefetch(prompt)
+            best.supervisor.pool.prefetch(prompt, best_ns)
 
     def _handoff_step(self) -> None:
         """The planned prefill→decode migration: every request on a
@@ -569,13 +595,17 @@ class ServeFleet:
                 h = sup.requests[rid]
                 dst, hit = self.router.route(
                     h.prompt, cand,
-                    demoted=frozenset(self._alert_demoted))
+                    demoted=frozenset(self._alert_demoted),
+                    adapter=getattr(h, "adapter", None))
                 if dst is src:
                     # degenerate fallback (every decode replica dead and
                     # the source is the only survivor): nothing to move to
                     continue
-                if hit and self.metrics is not None:
-                    self.metrics.on_affinity_hit()
+                if self.metrics is not None:
+                    if hit:
+                        self.metrics.on_affinity_hit()
+                    if self.router.last_adapter_hit:
+                        self.metrics.on_adapter_affinity_hit()
                 if self.trace is not None:
                     self.trace.on_migrate(h, self._now, src.idx, dst.idx)
                 h = sup.release(rid, dst=dst.idx, seal=False)
@@ -762,9 +792,13 @@ class ServeFleet:
             else:
                 cand = [r for r in targets if r.in_rotation] or targets
             dst, hit = self.router.route(
-                h.prompt, cand, demoted=frozenset(self._alert_demoted))
-            if hit and self.metrics is not None:
-                self.metrics.on_affinity_hit()
+                h.prompt, cand, demoted=frozenset(self._alert_demoted),
+                adapter=getattr(h, "adapter", None))
+            if self.metrics is not None:
+                if hit:
+                    self.metrics.on_affinity_hit()
+                if self.router.last_adapter_hit:
+                    self.metrics.on_adapter_affinity_hit()
             if self.trace is not None:
                 self.trace.on_migrate(h, prev_now, rep.idx, dst.idx)
             dst.supervisor.adopt(h, on_token=self._user_cb.get(h.rid))

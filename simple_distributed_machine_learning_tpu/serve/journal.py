@@ -48,6 +48,15 @@ in); the Python API surface calls it ``reason``
 Like ``tick``, it is absent when the writer supplies none — journals
 written before the field existed recover unchanged (regression-pinned).
 
+``adp`` is the request's ADAPTER NAME (multi-tenant LoRA serving;
+``serve/adapters.py``) on ``submit`` and ``snap`` records — part of the
+request's identity, because recovery must re-admit the request onto the
+same adapter or its continued stream would come from the wrong model.
+Absent for base-model requests AND in pre-adapter journals, which is the
+whole compatibility story: :func:`_request_from` reads it with
+``ev.get("adp")``, so old journals recover every request as base-model
+byte-identically (regression-pinned in tests/test_adapters.py).
+
 A ``handoff`` record marks a rid as MOVED OUT of this journal: the
 source replica writes it when the fleet hands the request to a decode
 replica (whose own journal now carries the authoritative ``snap``), and
@@ -127,7 +136,10 @@ def _request_from(ev: dict) -> Request:
         cls=ev["cls"],
         priority=int(ev["prio"]),
         ttft_deadline_s=ev["ttft_dl"],
-        deadline_s=ev["dl"])
+        deadline_s=ev["dl"],
+        # adapter identity: key absent = base model, which is also how
+        # every pre-adapter journal reads (module docstring)
+        adapter=ev.get("adp"))
     r.submit_time = ev["t"]
     return r
 
@@ -253,13 +265,14 @@ class RequestJournal:
 
     def log_submit(self, *, rid: int, prompt, max_new: int, temp: float,
                    top_k, top_p, eos, seed: int, cls, prio: int,
-                   ttft_dl, dl, t, tick=None) -> None:
+                   ttft_dl, dl, t, tick=None, adapter=None) -> None:
         self.append({"ev": "submit", "rid": rid,
                      "prompt": [int(x) for x in np.asarray(prompt)],
                      "max_new": int(max_new), "temp": float(temp),
                      "top_k": top_k, "top_p": top_p, "eos": eos,
                      "seed": int(seed), "cls": cls, "prio": int(prio),
                      "ttft_dl": ttft_dl, "dl": dl, "t": t,
+                     **({} if adapter is None else {"adp": adapter}),
                      **self._tick_field(tick)})
 
     def log_token(self, request: Request, token: int, tick=None) -> None:
@@ -322,6 +335,8 @@ class RequestJournal:
             "eos": request.eos_id, "seed": int(request.seed),
             "cls": request.cls, "prio": int(request.priority),
             "ttft_dl": request.ttft_deadline_s, "dl": request.deadline_s,
+            **({} if getattr(request, "adapter", None) is None
+               else {"adp": request.adapter}),
             "t": request.submit_time, "state": request.state,
             "reason": request.finish_reason,
             "toks": [int(t) for t in request.tokens],

@@ -133,6 +133,27 @@ tier, ``serve/slots.py``):
   the transfer-bandwidth bill ``predict_transfer_bytes`` reconciles with
   the same drift-must-be-zero discipline as ``serve_kv_drift_bytes``.
 
+Multi-tenant adapter instruments (ISSUE 20 — fed by the engine's
+per-tick ``AdapterStore.stats()`` payload, the router, and completion):
+
+- ``serve_adapter_resident_bytes`` (gauge) — HBM the device adapter bank
+  pins: the whole static ``[n_rows, L, d, r]`` stacked-A/B allocation
+  (``models/lora.py::bank_bytes`` — the analyzer's
+  ``predict_adapter_bytes`` reconciles this gauge EXACTLY, the same
+  parity discipline as ``serve_kv_bytes_predicted``);
+- ``serve_adapter_swaps_total`` (counter) — adapter bank-row uploads:
+  tick-boundary device writes that seated a tenant's weights (a
+  hot-swap or first admission; never a retrace — the bank is traced
+  data);
+- ``serve_route_adapter_affinity_hits_total`` (counter) — routing
+  decisions made by adapter residency: the request landed on a replica
+  already holding its adapter's current version on device, skipping a
+  bank-row upload (the hot-adapter-churn scenario pins this strictly
+  above round-robin);
+- ``serve_class_adapter`` (counter, labeled ``class=<adapter name>``) —
+  completed requests per TENANT: the per-adapter traffic split the
+  telemetry report's tenant block renders.
+
 Model-drift instruments (ISSUE 12 — the PR-8 static model checked as a
 runtime invariant, fed every tick from ``engine.kv_drift``):
 
@@ -275,6 +296,20 @@ class ServeMetrics:
                                for k, v in _HOST_COUNTERS.items()}
         self._host_counter_seen = dict.fromkeys(_HOST_COUNTERS, 0)
         self._host_seen = False
+        # multi-tenant adapter instruments (engines built with an
+        # AdapterStore feed the gauge/swap counter per tick; the fleet
+        # router feeds the affinity counter; completion feeds per-tenant)
+        self.adapter_resident_bytes = r.gauge(
+            "serve_adapter_resident_bytes")
+        self.adapter_swaps = r.counter("serve_adapter_swaps_total")
+        self.route_adapter_hits = r.counter(
+            "serve_route_adapter_affinity_hits_total")
+        # lifetime->delta swap accounting PER STORE (a fleet's replicas
+        # each own an AdapterStore but share this metrics object; one
+        # scalar would ratchet to the max instead of summing)
+        self._adapter_swaps_seen: dict[int, int] = {}
+        self._adapter_seen = False
+        self._adapter_names: set[str] = set()
         self._classes: set[str] = set()
         if outdir:
             os.makedirs(outdir, exist_ok=True)
@@ -379,6 +414,13 @@ class ServeMetrics:
         self._fleet_seen = True
         self.route_affinity_hits.inc()
 
+    def on_adapter_affinity_hit(self) -> None:
+        """The router's decision was made by adapter residency — the
+        destination already holds the request's adapter on device."""
+        self._fleet_seen = True
+        self._adapter_seen = True
+        self.route_adapter_hits.inc()
+
     def on_alert_demotion(self) -> None:
         """The router skipped the best affinity candidate because its
         per-replica burn alert was firing (the alert feedback loop)."""
@@ -426,10 +468,19 @@ class ServeMetrics:
         if span and span > 0:
             self.tokens_per_sec.set(self.tokens.value / span)
 
-    def on_complete(self, cls: str | None = None) -> None:
+    def on_complete(self, cls: str | None = None,
+                    adapter: str | None = None) -> None:
         self.completed.inc()
         if cls is not None:
             self._class_counter("serve_class_completed_total", cls).inc()
+        if adapter is not None:
+            # per-tenant traffic split; the label namespace is the
+            # adapter name (distinct from self._classes — tenants are
+            # not traffic classes)
+            self._adapter_seen = True
+            self._adapter_names.add(adapter)
+            self.registry.counter("serve_class_adapter",
+                                  labels={"class": adapter}).inc()
 
     def on_prefill_chunk(self, chunk_ms: float) -> None:
         """One prefill chunk's wall latency (paged engines; the dense
@@ -457,14 +508,18 @@ class ServeMetrics:
                 tp: int | None = None, spec_k: int | None = None,
                 kv_predicted: int | None = None,
                 kv_drift: int | None = None,
-                attn_kernel: str | None = None) -> None:
+                attn_kernel: str | None = None,
+                adapter_stats: dict | None = None) -> None:
         """End-of-tick gauges; ``decode_active`` is the occupancy the tick's
         batched decode ran at (sampled BEFORE same-tick retirement — the
         number batching converts into throughput). Ticks that ran no decode
         (``decode_active == 0``) skip the occupancy observation.
         ``block_stats`` is ``PagedKVPool.stats()`` — lifetime counters are
         converted to registry increments here. ``kv_predicted``/``kv_drift``
-        are the engine's per-tick model check (``engine.kv_drift``)."""
+        are the engine's per-tick model check (``engine.kv_drift``).
+        ``adapter_stats`` is ``AdapterStore.stats()`` (engines serving
+        multi-tenant adapters) — same lifetime-to-delta discipline for
+        the swap counter."""
         self.queue_depth.set(queue_depth)
         self.slots_active.set(active)
         self.slots_total.set(total)
@@ -478,6 +533,17 @@ class ServeMetrics:
             self.spec_k_gauge.set(spec_k or 0)
         if attn_kernel is not None:
             self.attn_kernel_gauge.set(int(attn_kernel == "fused"))
+        if adapter_stats is not None:
+            self._adapter_seen = True
+            self.adapter_resident_bytes.set(
+                adapter_stats["resident_bytes"])
+            sid = adapter_stats.get("store", 0)
+            delta = (adapter_stats["swaps_total"]
+                     - self._adapter_swaps_seen.get(sid, 0))
+            if delta > 0:
+                self.adapter_swaps.inc(delta)
+                self._adapter_swaps_seen[sid] = \
+                    adapter_stats["swaps_total"]
         occ = active if decode_active is None else decode_active
         if occ and total:
             self.occupancy.observe(occ / total)
@@ -636,6 +702,20 @@ class ServeMetrics:
                 "host_transfer_bytes": int(self._host_counters[
                     "host_transfer_bytes_total"].value),
             })
+        if self._adapter_seen:
+            out.update({
+                "adapter_resident_bytes": int(
+                    self.adapter_resident_bytes.value),
+                "adapter_swaps": int(self.adapter_swaps.value),
+                "route_adapter_affinity_hits": int(
+                    self.route_adapter_hits.value),
+            })
+            if self._adapter_names:
+                out["per_adapter_completed"] = {
+                    a: int(self.registry.counter(
+                        "serve_class_adapter",
+                        labels={"class": a}).value)
+                    for a in sorted(self._adapter_names)}
         if self._drift_seen:
             out["kv_bytes_predicted"] = int(self.kv_bytes_predicted.value)
             out["kv_drift_bytes"] = int(self.kv_drift_bytes.value)

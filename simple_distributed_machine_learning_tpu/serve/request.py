@@ -58,6 +58,12 @@ class Request:
     # against (serve/supervisor.py).
     ttft_deadline_s: float | None = None
     deadline_s: float | None = None
+    # multi-tenant serving: the named LoRA adapter this request decodes
+    # under (serve/adapters.py AdapterStore), None = the base model. Part
+    # of the request's IDENTITY — journaled (`adp`), carried across
+    # recovery/migration, and the prefix-cache namespace key, because K/V
+    # computed under one adapter is wrong for every other.
+    adapter: str | None = None
 
     # -- lifecycle (engine-owned) -----------------------------------------
     state: str = QUEUED
@@ -90,6 +96,15 @@ class Request:
     # scheduler bookkeeping: boarding order (set at admission), used by the
     # priority scheduler's newest-first victim pick
     _board_seq: int = -1
+    # the adapter-bank row this request's admission pinned (0 = base row;
+    # engine-transient — NOT identity: a re-admission or another replica
+    # may seat the same adapter on a different row)
+    _adapter_row: int = 0
+    # the resolved prefix-cache namespace (AdapterStore.namespace_of —
+    # version-qualified, set by the engine at submit/restore and refreshed
+    # at the admission gate); None = derive from `adapter` by name alone
+    # (pools driven without an adapter store). Engine-transient.
+    _prefix_ns: bytes | None = None
 
     @property
     def resume_seq(self) -> np.ndarray:

@@ -44,6 +44,20 @@ fallback. When the demotion actually changed the answer — the best
 affinity candidate over ALL candidates was demoted and skipped — the
 router records it on :attr:`last_suppressed` for the fleet's
 ``serve_route_alert_demotions_total`` counter.
+
+Multi-tenant adapters (ISSUE 20) add a second affinity signal to the
+``"affinity"`` policy: prefix probes are scoped to the request's
+ADAPTER NAMESPACE (a tenant can only reuse K/V its own adapter
+computed — ``serve/slots.py``), and when no replica holds a prefix, a
+replica where the adapter's current version is already DEVICE-RESIDENT
+(``AdapterStore.is_resident``) is preferred over the plain least-loaded
+answer — routing there skips a bank-row upload. Preference, never a
+refusal: with no resident replica the request routes least-loaded and
+the destination uploads the adapter at its admission tick. A decision
+made by adapter residency (or a prefix hit on a replica that also holds
+the adapter) is recorded on :attr:`last_adapter_hit` for the fleet's
+``serve_route_adapter_affinity_hits_total`` counter. The baseline
+policies stay adapter-blind — the hot-adapter-churn scenario's contrast.
 """
 
 from __future__ import annotations
@@ -72,6 +86,9 @@ class FleetRouter:
         #: last route() skipped the best affinity candidate because it was
         #: demoted — the fleet reads this to count alert demotions
         self.last_suppressed = False
+        #: last route() was decided by (or landed on) a replica holding
+        #: the request's adapter — the fleet's adapter-affinity counter
+        self.last_adapter_hit = False
 
     @staticmethod
     def _load_key(rep) -> tuple:
@@ -85,17 +102,35 @@ class FleetRouter:
                 pool.n_active / pool.n_slots,
                 rep.idx)
 
+    @staticmethod
+    def _adapter_state(rep, adapter) -> tuple:
+        """``(ns, resident)`` for probing ``rep`` on behalf of a request
+        under ``adapter``: the replica's OWN namespace for the adapter's
+        current version (``ns is None`` = this replica cannot serve the
+        tenant's cache at all — no adapter store), and whether that
+        version is device-resident there."""
+        if adapter is None:
+            return b"", False
+        store = getattr(rep.supervisor.engine, "_adapters", None)
+        if store is None:
+            return None, False
+        return store.namespace_of(adapter), store.is_resident(adapter)
+
     def route(self, prompt, candidates: list,
-              demoted: frozenset = frozenset()) -> tuple:
+              demoted: frozenset = frozenset(), adapter=None) -> tuple:
         """Pick the replica for ``prompt`` from ``candidates`` (the
         fleet's in-rotation list, index order, non-empty). ``demoted``
         holds replica indices whose burn alert is firing — still legal
-        targets (capacity is capacity), but never *preferred*."""
+        targets (capacity is capacity), but never *preferred*.
+        ``adapter`` is the request's tenant (None = base model): it
+        scopes the prefix probes and adds the residency preference
+        (module docstring)."""
         if not candidates:
             raise ValueError("route over an empty candidate list — the "
                              "fleet must always offer at least one "
                              "alive replica")
         self.last_suppressed = False
+        self.last_adapter_hit = False
         if self.policy == "round-robin":
             rep = candidates[self._rr % len(candidates)]
             self._rr += 1
@@ -104,25 +139,40 @@ class FleetRouter:
             prompt = np.asarray(prompt, np.int32)
             best, best_len = None, 0
             skipped_len = 0   # longest prefix held by a DEMOTED replica
+            resident = []     # non-demoted reps holding the adapter
             for rep in candidates:
                 pool = rep.supervisor.pool
+                ns, res = self._adapter_state(rep, adapter)
                 # HBM-registered prefix OR host-tier-resident prefix: a
                 # host hit is still an affinity hit — the blocks are one
                 # async upload away (pool.prefetch), which beats
                 # recomputing the prefix on a cold replica. Pools without
                 # a host tier answer 0, so the signal is unchanged there.
-                n = max(pool.shared_prefix_len(prompt),
-                        pool.host_prefix_len(prompt))
+                # Probes are NAMESPACE-scoped: only K/V this request's
+                # adapter computed counts as reusable.
+                n = 0 if ns is None else max(
+                    pool.shared_prefix_len(prompt, ns),
+                    pool.host_prefix_len(prompt, ns))
                 if rep.idx in demoted:
                     skipped_len = max(skipped_len, n)
-                elif n > best_len:
-                    best, best_len = rep, n
+                else:
+                    if res:
+                        resident.append(rep)
+                    if n > best_len:
+                        best, best_len = rep, n
             if skipped_len > best_len:
                 # the demotion changed the routing answer: the longest
                 # prefix lives on a firing replica and we went elsewhere
                 self.last_suppressed = True
             if best is not None:
+                self.last_adapter_hit = any(rep is best for rep in resident)
                 return best, True
+            if resident:
+                # no prefix anywhere, but the adapter is uploaded
+                # somewhere healthy: route where admission skips the
+                # bank-row swap, least-loaded among those replicas
+                self.last_adapter_hit = True
+                return min(resident, key=self._load_key), False
         # least-loaded: the standalone policy AND the affinity cold-start
         # fallback; demoted replicas sort after every healthy one
         return min(candidates,

@@ -97,6 +97,14 @@ class FCFSScheduler:
             if not self.pool.can_admit(r) and not self._make_room(r):
                 self.queue.appendleft(r)
                 break
+            # the adapter gate runs AFTER the pool accepts (a free slot is
+            # what makes a free bank row structurally certain) and BEFORE
+            # the slot binds: it pins/uploads the request's adapter-bank
+            # row at this tick boundary (serve/adapters.py)
+            gate = getattr(self._engine, "_adapter_board", None)
+            if gate is not None and not gate(r):
+                self.queue.appendleft(r)
+                break
             r.slot = self.pool.acquire(r.rid)
             # bind INSIDE the loop: the paged pool reserves this request's
             # block budget here, so the next iteration's can_admit probe

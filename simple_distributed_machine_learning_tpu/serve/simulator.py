@@ -66,6 +66,11 @@ class TrafficClass:
     # offload-churn scenario uses one to push the idle prefix out of HBM
     # so the host tier's demote/prefetch cycle actually exercises)
     shared_prefix: bool = True
+    # multi-tenant LoRA serving (ISSUE 20): every request of this class
+    # decodes through the named adapter (registered on the target before
+    # traffic starts — resilience/scenarios.py does this); None = the
+    # shared base model
+    adapter: str | None = None
 
     def __post_init__(self):
         if not self.name:
@@ -249,6 +254,8 @@ def build_workload(sim: SimConfig, vocab: int) -> tuple[np.ndarray, list]:
         if cls is not None:
             spec["cls"] = cls.name
             spec["priority"] = cls.priority
+            if cls.adapter is not None:
+                spec["adapter"] = cls.adapter
             if cls.ttft_deadline_ms is not None:
                 spec["ttft_deadline_s"] = cls.ttft_deadline_ms / 1e3
             if cls.deadline_ms is not None:

@@ -120,6 +120,29 @@ def _bind_budget_of(request) -> int:
     return request.max_new_tokens if budget is None else budget
 
 
+def _ns_of(request) -> bytes:
+    """The request's prefix-cache NAMESPACE: K/V computed under one LoRA
+    adapter is wrong for every other, so registry keys are scoped by the
+    request's adapter. The engine resolves the VERSION-QUALIFIED
+    namespace onto ``_prefix_ns`` (AdapterStore.namespace_of — a hot-swap
+    changes it, orphaning the old version's keys); a pool driven without
+    the engine's adapter plumbing falls back to the bare name
+    (serve/adapters.py::adapter_namespace, imported lazily so a pool
+    without adapters never touches the adapter module). Base-model
+    requests get the EMPTY namespace: their keys stay byte-identical to
+    the pre-adapter registry."""
+    ns = getattr(request, "_prefix_ns", None)
+    if ns is not None:
+        return ns
+    adapter = getattr(request, "adapter", None)
+    if adapter is None:
+        return b""
+    from simple_distributed_machine_learning_tpu.serve.adapters import (
+        adapter_namespace,
+    )
+    return adapter_namespace(adapter)
+
+
 class _SlotPoolBase:
     """Slot occupancy accounting shared by both layouts: the free-slot list
     with invariant guards, and the per-slot decode state (position counters
@@ -209,13 +232,14 @@ class _SlotPoolBase:
 
     # -- routing affinity (FleetRouter's signal) -----------------------------
 
-    def shared_prefix_len(self, prompt) -> int:
+    def shared_prefix_len(self, prompt, ns: bytes = b"") -> int:
         """Prompt positions this pool could serve from already-registered
         prefix blocks — the fleet router's affinity signal
-        (``serve/router.py``). The dense layout shares nothing: 0."""
+        (``serve/router.py``); ``ns`` scopes the probe to one adapter's
+        key space. The dense layout shares nothing: 0."""
         return 0
 
-    def host_prefix_len(self, prompt) -> int:
+    def host_prefix_len(self, prompt, ns: bytes = b"") -> int:
         """Prompt positions resident in this pool's HOST offload tier — the
         router's second affinity signal (an affinity hit here starts the
         async prefetch upload). Pools without a host tier: 0."""
@@ -410,6 +434,11 @@ class PagedKVPool(_SlotPoolBase):
         # per-slot sequence state
         self.tables: list[list[int]] = [[] for _ in range(n_slots)]
         self._resv = np.zeros(n_slots, np.int64)
+        # per-slot prefix-cache namespace, set at bind: register_prefix
+        # publishes this slot's blocks under the SAME adapter scope its
+        # probe matched in, so cross-tenant K/V sharing is structurally
+        # impossible (serve/adapters.py)
+        self._slot_ns: list[bytes] = [b""] * n_slots
         # lifetime counters (ServeMetrics reads the deltas)
         self.prefix_hit_blocks_total = 0
         self.cow_copies_total = 0
@@ -518,7 +547,7 @@ class PagedKVPool(_SlotPoolBase):
             if self.ref[b] == 1 and not self._cached.get(b))
 
     def begin_seq(self, slot: int, prompt: np.ndarray,
-                  max_new_tokens: int) -> int:
+                  max_new_tokens: int, ns: bytes = b"") -> int:
         """Attach a sequence to an acquired slot: match the longest
         registered prompt prefix (incref'ing the shared blocks into this
         slot's table) and reserve the worst-case budget for the rest.
@@ -531,7 +560,8 @@ class PagedKVPool(_SlotPoolBase):
                 f"begin_seq on slot {slot} with a live block table or "
                 f"reservation — the previous sequence was never ended")
         prompt = np.asarray(prompt)
-        shared_len, chain = self._probe_prefix(prompt)
+        self._slot_ns[slot] = ns
+        shared_len, chain = self._probe_prefix(prompt, ns)
         for block, _fill in chain:
             self._ref_block(block)
             self.tables[slot].append(block)
@@ -554,7 +584,7 @@ class PagedKVPool(_SlotPoolBase):
         # fresh requests; after a preemption they cover the already-emitted
         # tokens whose K/V re-admission must recompute (serve/request.py)
         return self.begin_seq(request.slot, _bind_seq_of(request),
-                              _bind_budget_of(request))
+                              _bind_budget_of(request), ns=_ns_of(request))
 
     def unbind_seq(self, slot: int) -> None:
         self.end_seq(slot)
@@ -570,6 +600,7 @@ class PagedKVPool(_SlotPoolBase):
                 del self._block_writer[block]
             self._unref_block(block)
         self.tables[slot] = []
+        self._slot_ns[slot] = b""
         self._reserved -= int(self._resv[slot])
         self._resv[slot] = 0
 
@@ -671,12 +702,13 @@ class PagedKVPool(_SlotPoolBase):
 
     # -- prefix registry ---------------------------------------------------
 
-    def shared_prefix_len(self, prompt) -> int:
+    def shared_prefix_len(self, prompt, ns: bytes = b"") -> int:
         """The paged affinity signal: longest registered prefix of
-        ``prompt`` (in positions) this pool already holds. A pure probe —
-        no referencing, no memo, no registry mutation — so the router may
-        ask every replica without perturbing any pool."""
-        return self._probe_prefix(np.asarray(prompt, np.int32))[0]
+        ``prompt`` (in positions) this pool already holds in namespace
+        ``ns``. A pure probe — no referencing, no memo, no registry
+        mutation — so the router may ask every replica without perturbing
+        any pool."""
+        return self._probe_prefix(np.asarray(prompt, np.int32), ns)[0]
 
     def _probe_cached(self, request) -> tuple[int, list[tuple[int, int]]]:
         """Probe memoized on the request, keyed by the registry epoch AND
@@ -690,15 +722,16 @@ class PagedKVPool(_SlotPoolBase):
         memo = getattr(request, "_prefix_probe", None)
         if memo is not None and memo[0] == key:
             return memo[1], memo[2]
-        shared_len, chain = self._probe_prefix(seq)
+        shared_len, chain = self._probe_prefix(seq, _ns_of(request))
         request._prefix_probe = (key, shared_len, chain)
         return shared_len, chain
 
-    def _probe_prefix(self, prompt: np.ndarray
+    def _probe_prefix(self, prompt: np.ndarray, ns: bytes = b""
                       ) -> tuple[int, list[tuple[int, int]]]:
-        """Longest registered chain prefixing ``prompt`` (capped at
-        ``prompt_len - 1`` so at least one position is always recomputed).
-        Returns ``(shared_len, [(block, fill), ...])`` without mutating."""
+        """Longest registered chain prefixing ``prompt`` within namespace
+        ``ns`` (capped at ``prompt_len - 1`` so at least one position is
+        always recomputed). Returns ``(shared_len, [(block, fill), ...])``
+        without mutating."""
         prompt = np.asarray(prompt, np.int32)
         cap = int(prompt.shape[0]) - 1
         bs = self.block_size
@@ -710,7 +743,7 @@ class PagedKVPool(_SlotPoolBase):
             # the longest key covering block j that still prefixes prompt:
             # full block first, then partial fills from longest down
             for length in range(min(cap, (j + 1) * bs), j * bs, -1):
-                entry = self._prefix.get(prompt[:length].tobytes())
+                entry = self._prefix.get(ns + prompt[:length].tobytes())
                 if entry is not None:
                     hit = (entry[0], length - j * bs)
                     break
@@ -727,14 +760,18 @@ class PagedKVPool(_SlotPoolBase):
         """Publish ``slot``'s freshly prefilled prompt blocks to the
         registry: one key per full block boundary plus the partial tail, so
         later requests with the same prefix share instead of recompute.
-        First writer wins — an existing key keeps its block."""
+        First writer wins — an existing key keeps its block. Keys are
+        published under the slot's bind-time namespace, so an identical
+        prompt under a DIFFERENT adapter probes past them — cross-tenant
+        K/V sharing is the one bug this scoping makes impossible."""
         prompt = np.asarray(prompt, np.int32)
+        ns = self._slot_ns[slot]
         bs = self.block_size
         table = self.tables[slot]
         plen = int(prompt.shape[0])
         for j in range(self.blocks_for(plen)):
             fill = min(plen - j * bs, bs)
-            key = prompt[:j * bs + fill].tobytes()
+            key = ns + prompt[:j * bs + fill].tobytes()
             if key in self._prefix:
                 continue
             block = table[j]
@@ -802,16 +839,20 @@ class PagedKVPool(_SlotPoolBase):
         if not entry["keys"]:
             del self._host[hid]
 
-    def host_prefix_len(self, prompt) -> int:
+    def host_prefix_len(self, prompt, ns: bytes = b"") -> int:
         """The host-tier affinity signal: longest host-resident prefix of
-        ``prompt`` (in positions). A pure probe, like
-        :meth:`shared_prefix_len` — the router may ask freely."""
-        return self._probe_host(np.asarray(prompt, np.int32))[0]
+        ``prompt`` (in positions) under the ``ns`` adapter namespace. A
+        pure probe, like :meth:`shared_prefix_len` — the router may ask
+        freely."""
+        return self._probe_host(np.asarray(prompt, np.int32), ns)[0]
 
-    def _probe_host(self, prompt: np.ndarray
+    def _probe_host(self, prompt: np.ndarray, ns: bytes = b""
                     ) -> tuple[int, list[tuple[bytes, int, int]]]:
-        """:meth:`_probe_prefix`'s walk against the HOST registry. Returns
-        ``(shared_len, [(key, fill, host_id), ...])`` without mutating."""
+        """:meth:`_probe_prefix`'s walk against the HOST registry. Host
+        keys are the demoted device-registry keys, so they already carry
+        the adapter namespace — probing just prepends the same ``ns``.
+        Returns ``(shared_len, [(key, fill, host_id), ...])`` without
+        mutating."""
         prompt = np.asarray(prompt, np.int32)
         cap = int(prompt.shape[0]) - 1
         bs = self.block_size
@@ -821,7 +862,7 @@ class PagedKVPool(_SlotPoolBase):
         while True:
             hit = None
             for length in range(min(cap, (j + 1) * bs), j * bs, -1):
-                key = prompt[:length].tobytes()
+                key = ns + prompt[:length].tobytes()
                 entry = self._host_prefix.get(key)
                 if entry is not None:
                     hit = (key, length - j * bs, entry[0])
@@ -835,7 +876,7 @@ class PagedKVPool(_SlotPoolBase):
             j += 1
         return shared, chain
 
-    def prefetch(self, prompt) -> bool:
+    def prefetch(self, prompt, ns: bytes = b"") -> bool:
         """Routing-time async upload: start moving ``prompt``'s
         host-resident prefix blocks back into HBM so they are registered
         (and shareable) before the request's slot boards. Returns True on
@@ -855,8 +896,8 @@ class PagedKVPool(_SlotPoolBase):
         if not self.host_cache_blocks:
             return False
         prompt = np.asarray(prompt, np.int32)
-        host_len, chain = self._probe_host(prompt)
-        dev_len = self._probe_prefix(prompt)[0]
+        host_len, chain = self._probe_host(prompt, ns)
+        dev_len = self._probe_prefix(prompt, ns)[0]
         chain = [(k, f, hid) for (k, f, hid) in chain
                  if k not in self._prefix]
         if host_len <= dev_len or not chain:
@@ -904,7 +945,8 @@ class PagedKVPool(_SlotPoolBase):
     def prefetch_blocked(self, request) -> bool:
         if not self._inflight:
             return False
-        seq_b = np.asarray(_bind_seq_of(request), np.int32).tobytes()
+        seq_b = _ns_of(request) + np.asarray(
+            _bind_seq_of(request), np.int32).tobytes()
         for t in self._inflight:
             for key, _f, _hk, _hv in t["entries"]:
                 if len(key) < len(seq_b) and seq_b.startswith(key):

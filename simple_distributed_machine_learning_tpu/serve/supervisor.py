@@ -151,7 +151,9 @@ class OverloadPolicy:
 
 def engine_factory(stages, cfg, *, metrics=None, clock=time.monotonic,
                    scheduler=None, mesh=None, draft_stages=None,
-                   draft_cfg=None, spec_k: int = 0, **kw):
+                   draft_cfg=None, spec_k: int = 0,
+                   adapter_rank: int = 0, adapter_host: dict | None = None,
+                   **kw):
     """The standard ``factory(degraded) -> InferenceEngine`` closure.
 
     Non-degraded builds get the full deployment (paged knobs, TP mesh,
@@ -164,6 +166,12 @@ def engine_factory(stages, cfg, *, metrics=None, clock=time.monotonic,
     streams all equal the solo decode; sampled speculative streams are
     deterministic but consume the key streams differently).
 
+    ``adapter_rank > 0`` turns on multi-tenant LoRA serving: every build
+    (degraded ones included — the fallback drops layout/speed features,
+    never tenants) gets a FRESH :class:`~.adapters.AdapterStore` over one
+    SHARED ``adapter_host`` dict, so registered adapters survive crash
+    rebuilds while device residency honestly resets with the engine.
+
     ``scheduler`` must be a CLASS/factory (each rebuilt engine constructs
     its own instance over its own pool); ``metrics``/``clock`` are shared
     across rebuilds so counters and timelines stay continuous.
@@ -171,13 +179,26 @@ def engine_factory(stages, cfg, *, metrics=None, clock=time.monotonic,
     from simple_distributed_machine_learning_tpu.serve.engine import (
         InferenceEngine,
     )
+    if adapter_rank > 0 and adapter_host is None:
+        adapter_host = {}        # one dict across every rebuild
+
+    def _adapter_kw(n_slots: int) -> dict:
+        if adapter_rank <= 0:
+            return {}
+        from simple_distributed_machine_learning_tpu.serve.adapters import (
+            AdapterStore,
+        )
+        return {"adapters": AdapterStore(cfg, adapter_rank, n_slots,
+                                         host=adapter_host)}
 
     def factory(degraded: bool) -> InferenceEngine:
+        n_slots = kw.get("n_slots", 4)
         if not degraded:
             return InferenceEngine(
                 stages, cfg, metrics=metrics, clock=clock,
                 scheduler=scheduler, mesh=mesh, draft_stages=draft_stages,
-                draft_cfg=draft_cfg, spec_k=spec_k, **kw)
+                draft_cfg=draft_cfg, spec_k=spec_k,
+                **_adapter_kw(n_slots), **kw)
         dcfg = cfg
         if getattr(cfg, "n_tensor_parallel", 1) > 1:
             dcfg = dataclasses.replace(cfg, n_tensor_parallel=1)
@@ -195,7 +216,8 @@ def engine_factory(stages, cfg, *, metrics=None, clock=time.monotonic,
             dkw["cache_dtype"] = None
         return InferenceEngine(stages, dcfg, kv_layout="dense",
                                metrics=metrics, clock=clock,
-                               scheduler=scheduler, **dkw)
+                               scheduler=scheduler,
+                               **_adapter_kw(n_slots), **dkw)
 
     return factory
 
@@ -324,7 +346,8 @@ class ServeSupervisor:
                on_token=None, arrival_time: float | None = None,
                cls: str | None = None, priority: int = 0,
                ttft_deadline_s: float | None = None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               adapter: str | None = None) -> Request:
         """Admission-controlled, journaled submit.  The returned handle may
         already be ``SHED`` (a structured rejection — the request never
         reached the engine); otherwise the submission is journaled BEFORE
@@ -336,9 +359,12 @@ class ServeSupervisor:
             deadline_s = self.default_deadline_s
         prompt = np.asarray(prompt, np.int32)
         # validate BEFORE journaling: a rejected submission must not leave
-        # a journal entry recovery would forever fail to re-admit
+        # a journal entry recovery would forever fail to re-admit (the
+        # adapter check included — an unregistered tenant must fail here,
+        # not as a poisoned `adp` record)
         validate_request(prompt, max_new_tokens, temperature, top_k, top_p,
                          self.engine.cfg.vocab, self.engine.max_len)
+        self.engine._check_adapter(adapter)
         rid = self.engine._next_rid      # the rid engine.submit will assign
         seed = rid if seed is None else seed
         reason = self._admission_check(cls, priority, now)
@@ -346,20 +372,20 @@ class ServeSupervisor:
             return self._shed_at_admission(
                 rid, prompt, max_new_tokens, temperature, top_k, top_p,
                 eos_id, seed, cls, priority, ttft_deadline_s, deadline_s,
-                reason, now)
+                reason, now, adapter=adapter)
         self._user_cb[rid] = on_token
         self.journal.log_submit(
             rid=rid, prompt=prompt, max_new=max_new_tokens,
             temp=temperature, top_k=top_k, top_p=top_p, eos=eos_id,
             seed=seed, cls=cls, prio=priority, ttft_dl=ttft_deadline_s,
-            dl=deadline_s, t=now, tick=self.tick)
+            dl=deadline_s, t=now, tick=self.tick, adapter=adapter)
         try:
             r = self.engine.submit(
                 prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_id=eos_id, seed=seed,
                 on_token=self._on_token, arrival_time=now, cls=cls,
                 priority=priority, ttft_deadline_s=ttft_deadline_s,
-                deadline_s=deadline_s)
+                deadline_s=deadline_s, adapter=adapter)
         except RECOVERABLE as e:
             # the serve.admit crash: the journal already carries this
             # submission, so recovery rebuilds and re-admits it
@@ -369,6 +395,18 @@ class ServeSupervisor:
         self.requests[rid] = r
         self._open.add(rid)
         return r
+
+    def register_adapter(self, name: str, weights: dict) -> None:
+        """Add or hot-swap a named LoRA adapter (host-side; the next
+        admission of the name uploads it at a tick boundary). Registration
+        lands in the factory's SHARED host dict, so it survives crash
+        rebuilds — a recovered request re-admits onto the same tenant."""
+        store = getattr(self.engine, "_adapters", None)
+        if store is None:
+            raise ValueError(
+                "this supervisor's engine was built without an "
+                "AdapterStore — pass adapter_rank= to engine_factory")
+        store.register(name, weights)
 
     def step(self) -> int:
         """One supervised tick: deadline shedding, then the engine tick
@@ -570,7 +608,8 @@ class ServeSupervisor:
 
     def _shed_at_admission(self, rid, prompt, max_new, temperature, top_k,
                            top_p, eos_id, seed, cls, priority, ttft_dl, dl,
-                           reason: str, now: float) -> Request:
+                           reason: str, now: float,
+                           adapter: str | None = None) -> Request:
         """A structured rejection: the handle exists (state SHED, the
         reason in ``finish_reason``) but the engine never saw the request.
         The rid is consumed so the journal's id space stays unique, and
@@ -580,7 +619,7 @@ class ServeSupervisor:
         r = Request(rid=rid, prompt=prompt, max_new_tokens=max_new,
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     eos_id=eos_id, seed=seed, cls=cls, priority=priority,
-                    ttft_deadline_s=ttft_dl, deadline_s=dl)
+                    ttft_deadline_s=ttft_dl, deadline_s=dl, adapter=adapter)
         r.submit_time = now
         r.done_time = now
         r.state = SHED
@@ -588,7 +627,8 @@ class ServeSupervisor:
         self.journal.log_submit(
             rid=rid, prompt=prompt, max_new=max_new, temp=temperature,
             top_k=top_k, top_p=top_p, eos=eos_id, seed=seed, cls=cls,
-            prio=priority, ttft_dl=ttft_dl, dl=dl, t=now, tick=self.tick)
+            prio=priority, ttft_dl=ttft_dl, dl=dl, t=now, tick=self.tick,
+            adapter=adapter)
         self.journal.log_shed(rid=rid, reason=reason, t=now, tick=self.tick)
         self.requests[rid] = r
         self._sheds_since_step += 1

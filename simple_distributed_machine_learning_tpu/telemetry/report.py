@@ -9,7 +9,9 @@ transition log (``kind: "slo_alert"`` records — the burn-rate state
 machine's journal) and the per-scenario TTFT attribution block with its
 top-K slow-request autopsy table, the restart
 timeline (journal ``restart`` events with their monotonic ticks), TTFT /
-TPOT quantiles, KV-drift, the disaggregated-pool block (per-role replica/
+TPOT quantiles, KV-drift, the multi-tenant adapter block (bank residency
+bytes, swaps, adapter-affinity routing hits, per-tenant completions and
+the per-journal tenant split), the disaggregated-pool block (per-role replica/
 queue/slot gauges plus the host offload tier's demote/promote/prefetch
 counters and the per-journal snap-cause split), the training-resilience
 block (the self-healing sentinel's anomaly/rollback/quarantine counters
@@ -82,6 +84,7 @@ def collect(outdir: str) -> dict:
         events = _read_jsonl(path)
         counts: dict[str, int] = {}
         snap_why: dict[str, int] = {}
+        adapters: dict[str, int] = {}
         for ev in events:
             counts[ev.get("ev", "?")] = counts.get(ev.get("ev", "?"), 0) + 1
             if ev.get("ev") == "snap":
@@ -89,10 +92,15 @@ def collect(outdir: str) -> dict:
                 # snaps predate the field and count as "-"
                 why = ev.get("why") or "-"
                 snap_why[why] = snap_why.get(why, 0) + 1
+            if ev.get("ev") == "submit" and ev.get("adp"):
+                # tenant split: the adp field is absent for base-model
+                # requests and in pre-adapter journals
+                adapters[ev["adp"]] = adapters.get(ev["adp"], 0) + 1
         journals[os.path.basename(path)] = {
             "events": len(events),
             "by_kind": dict(sorted(counts.items())),
             "snap_why": dict(sorted(snap_why.items())),
+            "adapters": dict(sorted(adapters.items())),
             "restarts": [
                 {"n": ev.get("n"), "cause": ev.get("cause"),
                  "degraded": ev.get("degraded"), "tick": ev.get("tick")}
@@ -264,6 +272,15 @@ def render(report: dict) -> str:
                 f"  kv drift: live-vs-model {s['kv_drift_bytes']} bytes "
                 f"[{ok}] (predicted {s.get('kv_bytes_predicted')}, "
                 f"resident {s.get('kv_bytes_resident', 'n/a')})")
+        if "adapter_resident_bytes" in s:
+            lines.append(
+                f"  adapters: {s['adapter_resident_bytes']} bytes "
+                f"resident (bank), {s.get('adapter_swaps', 0)} swap(s), "
+                f"{s.get('route_adapter_affinity_hits', 0)} "
+                f"adapter-affinity hit(s)")
+            for tenant, n in sorted(
+                    (s.get("per_adapter_completed") or {}).items()):
+                lines.append(f"    tenant {tenant}: {n} completed")
         for cls, blk in sorted((s.get("per_class") or {}).items()):
             lines.append(
                 f"  class {cls}: {blk.get('completed', 0)} completed, "
@@ -347,6 +364,8 @@ def render(report: dict) -> str:
                if k != "-"}
         if why:
             lines.append(f"    snap cause: {why}")
+        if j.get("adapters"):
+            lines.append(f"    tenants: {j['adapters']}")
         for r in j["restarts"]:
             lines.append(
                 f"    restart #{r['n']} @tick {_fmt(r['tick'])} "

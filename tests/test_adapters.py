@@ -1,0 +1,512 @@
+"""Multi-tenant LoRA serving (serve/adapters + models/lora): parity, store
+invariants, recovery, isolation, routing.
+
+The load-bearing property mirrors the serving suite's: per-tenant adapters
+are a RESIDENCY optimization, not a math change — for every request naming
+adapter ``t``, the engine's batched bank-row apply is bit-exact vs decoding
+that request alone through a model whose weights were merged offline
+(``lora.merge_adapter``), across greedy AND sampled streams, mixed tenants
+sharing one tick, paged f32 and int8 caches, preemption, tick-boundary
+hot-swap and a crash-restart.  Plus the AdapterStore invariants (row 0 is
+the zero-delta base and never allocated, refcounted rows never evicted
+while referenced, version bumps orphan stale rows and prefix namespaces),
+the journal grammar (``adp`` rides submit records; pre-adapter journals
+recover as base), adapter-aware fleet routing, the pinned
+``hot-adapter-churn`` scenario, and the analyzer parity pin
+(``predict_adapter_bytes`` == live store == metrics gauge, exactly).
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from simple_distributed_machine_learning_tpu.models import lora
+from simple_distributed_machine_learning_tpu.models.gpt import (
+    GPTConfig,
+    make_cached_decoder,
+    make_gpt_stages,
+)
+from simple_distributed_machine_learning_tpu.resilience import faults
+from simple_distributed_machine_learning_tpu.resilience.scenarios import (
+    VirtualClock,
+    run_scenario,
+)
+from simple_distributed_machine_learning_tpu.serve import (
+    InferenceEngine,
+    RequestJournal,
+    ServeFleet,
+    ServeMetrics,
+    ServeSupervisor,
+    engine_factory,
+)
+from simple_distributed_machine_learning_tpu.serve.adapters import (
+    AdapterStore,
+    adapter_namespace,
+)
+from simple_distributed_machine_learning_tpu.serve.journal import (
+    read_journal,
+    recover_state,
+)
+
+CFG = GPTConfig(vocab=32, seq_len=48, d_model=32, n_heads=2, n_layers=2)
+_STAGES = None
+
+
+def _model():
+    global _STAGES
+    if _STAGES is None:
+        _STAGES = make_gpt_stages(jax.random.key(0), CFG, 2)[0]
+    return _STAGES, [s.params for s in _STAGES]
+
+
+def _solo(stages, params, prompt, n_new, seed, temperature=0.0, top_k=None,
+          top_p=None):
+    dec = make_cached_decoder(stages, CFG, len(prompt), n_new,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p)
+    out = dec(params, np.asarray(prompt, np.int32)[None],
+              jax.random.key(seed))
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _prompt(n, seed):
+    return np.asarray(
+        jax.random.randint(jax.random.key(seed), (n,), 0, CFG.vocab),
+        np.int32)
+
+
+def _adapter(seed, rank=2):
+    """A NON-TRIVIAL adapter: ``init_lora_adapter`` zeroes B (a fresh
+    adapter is the base model), so parity against merged weights would be
+    vacuous — perturb B so the delta actually bends the logits."""
+    w = dict(lora.init_lora_adapter(jax.random.key(seed), CFG, rank))
+    kq, kv = jax.random.split(jax.random.key(seed + 9000))
+    w["bq"] = 0.05 * jax.random.normal(kq, w["bq"].shape, w["bq"].dtype)
+    w["bv"] = 0.05 * jax.random.normal(kv, w["bv"].shape, w["bv"].dtype)
+    return w
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# AdapterStore: registration, versioning, residency
+
+
+def test_store_rejects_bad_names_and_shapes():
+    store = AdapterStore(CFG, 2, 2)
+    with pytest.raises(ValueError):
+        store.register("", _adapter(1))
+    with pytest.raises(ValueError):
+        store.register("a\x00b", _adapter(1))
+    with pytest.raises(ValueError):
+        store.register("t1", lora.init_lora_adapter(
+            jax.random.key(0), CFG, 4))        # rank mismatch
+    bad = dict(_adapter(1))
+    del bad["bv"]
+    with pytest.raises(ValueError):
+        store.register("t1", bad)
+    assert store.names() == ()
+
+
+def test_namespaces_version_qualified_and_base_empty():
+    """The prefix-cache namespace carries the registration VERSION, so a
+    hot-swap orphans the old version's cached blocks; base (None) is the
+    pool's pre-adapter empty namespace, so base requests keep sharing
+    prefixes with every non-adapter engine ever journaled."""
+    store = AdapterStore(CFG, 2, 2)
+    assert store.namespace_of(None) == b""
+    store.register("t1", _adapter(1))
+    ns0 = store.namespace_of("t1")
+    assert ns0 == adapter_namespace("t1@0") != b""
+    store.register("t1", _adapter(2))          # hot-swap: version bump
+    assert store.namespace_of("t1") == adapter_namespace("t1@1") != ns0
+
+
+def test_residency_refcount_eviction_and_release_guards():
+    """n_slots+1 sizing: row 0 is the pinned zero-delta base; referenced
+    rows are never evicted; a zero-ref resident row IS evicted when a
+    third tenant needs the bank; release(0) is the base no-op and a
+    double release raises."""
+    store = AdapterStore(CFG, 2, 2)            # rows 1..2 usable
+    for k in (1, 2, 3):
+        store.register(f"t{k}", _adapter(k))
+    r1 = store.retain("t1")
+    r2 = store.retain("t2")
+    assert {r1, r2} == {1, 2} and store.swaps_total == 2
+    assert store.is_resident("t1") and store.row_of("t1") == r1
+    store.release(r1)                          # t1 stays resident (warm)...
+    assert store.is_resident("t1")
+    r3 = store.retain("t3")                    # ...until t3 needs the row
+    assert r3 == r1 and not store.is_resident("t1")
+    assert store.swaps_total == 3
+    assert store.retain("t3") == r3 and store.swaps_total == 3  # no re-upload
+    store.release(0)                           # base rows carry no refs
+    store.release(r3)
+    store.release(r3)
+    with pytest.raises(RuntimeError):
+        store.release(r3)
+    store.release(r2)
+
+
+def test_hot_swap_keeps_referenced_row_until_released():
+    """Re-registering a live tenant must not clobber the row an in-flight
+    request is decoding against: the old version's row stays pinned, the
+    next retain uploads the new version into a DIFFERENT row."""
+    store = AdapterStore(CFG, 2, 2)
+    store.register("t1", _adapter(1))
+    old_row = store.retain("t1")
+    store.register("t1", _adapter(2))          # swap while referenced
+    assert not store.is_resident("t1")         # current version not uploaded
+    new_row = store.retain("t1")
+    assert new_row != old_row
+    store.release(old_row)
+    store.release(new_row)
+
+
+def test_shared_host_survives_store_rebuild():
+    """The crash-recovery contract: a rebuilt store constructed over the
+    SAME host dict (supervisor's engine factory) serves every previously
+    registered tenant with its version accounting intact."""
+    host = {}
+    s1 = AdapterStore(CFG, 2, 2, host=host)
+    s1.register("t1", _adapter(1))
+    s1.register("t1", _adapter(2))
+    s2 = AdapterStore(CFG, 2, 2, host=host)    # the post-crash rebuild
+    assert s2.is_registered("t1") and not s2.is_resident("t1")
+    assert s2.retain("t1") > 0
+    assert s2.stats()["store"] != s1.stats()["store"]
+
+
+# ---------------------------------------------------------------------------
+# THE parity anchor: engine streams bit-exact vs offline-merged weights
+
+
+def test_mixed_tenant_streams_match_merged_dense_solo():
+    """Base + two tenants interleaved through 2 slots (so ticks mix
+    bank rows and admissions happen mid-flight), greedy AND sampled:
+    every stream is bit-exact vs a solo decode through weights merged
+    offline with ``lora.merge_adapter`` — the adapter path is a residency
+    optimization, not a math change."""
+    stages, params = _model()
+    w1, w2 = _adapter(1), _adapter(2)
+    eng = InferenceEngine(stages, CFG, n_slots=2,
+                          adapters=AdapterStore(CFG, 2, 2))
+    eng.register_adapter("t1", w1)
+    eng.register_adapter("t2", w2)
+    specs = [
+        dict(prompt=_prompt(5, 1), max_new_tokens=7, seed=11),
+        dict(prompt=_prompt(9, 2), max_new_tokens=5, seed=12, adapter="t1",
+             temperature=0.8, top_k=5),
+        dict(prompt=_prompt(3, 3), max_new_tokens=8, seed=13, adapter="t2"),
+        dict(prompt=_prompt(7, 4), max_new_tokens=6, seed=14, adapter="t1"),
+        dict(prompt=_prompt(4, 5), max_new_tokens=6, seed=15, adapter="t2",
+             temperature=1.1, top_p=0.9),
+    ]
+    handles = [eng.submit(**specs[i]) for i in range(3)]
+    for _ in range(3):
+        eng.step()                             # mid-flight admissions
+    handles += [eng.submit(**s) for s in specs[3:]]
+    eng.drain()
+    merged = {None: params,
+              "t1": lora.merge_adapter(params, w1),
+              "t2": lora.merge_adapter(params, w2)}
+    # non-vacuous: the perturbed adapters actually change the weights
+    assert any(not np.allclose(a, b) for a, b in
+               zip(jax.tree.leaves(merged["t1"]), jax.tree.leaves(params)))
+    for h, s in zip(handles, specs):
+        np.testing.assert_array_equal(
+            h.tokens, _solo(stages, merged[s.get("adapter")], s["prompt"],
+                            s["max_new_tokens"], s["seed"],
+                            temperature=s.get("temperature", 0.0),
+                            top_k=s.get("top_k"), top_p=s.get("top_p")))
+
+
+def test_adapter_parity_int8_cache():
+    """Same anchor under the quantized KV cache: adapter engine vs an
+    engine built from the merged weights, identical layout and cache
+    dtype — engine-to-engine so quantization error cancels exactly."""
+    stages, params = _model()
+    w1 = _adapter(6)
+    eng = InferenceEngine(stages, CFG, n_slots=2, cache_dtype="int8",
+                          adapters=AdapterStore(CFG, 2, 2))
+    eng.register_adapter("t1", w1)
+    merged_stages = [dataclasses.replace(s, params=p) for s, p in
+                     zip(stages, lora.merge_adapter(params, w1))]
+    ref = InferenceEngine(merged_stages, CFG, n_slots=2, cache_dtype="int8")
+    specs = [dict(prompt=_prompt(6, 21), max_new_tokens=6, seed=31),
+             dict(prompt=_prompt(4, 22), max_new_tokens=5, seed=32,
+                  temperature=0.9, top_k=4)]
+    got = [eng.submit(**s, adapter="t1") for s in specs]
+    want = [ref.submit(**s) for s in specs]
+    eng.drain()
+    ref.drain()
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g.tokens, w.tokens)
+
+
+def test_adapter_parity_survives_preemption():
+    """Preempt a tenant's request mid-decode: it re-boards (possibly into
+    a different slot and bank row) and its full stream still matches the
+    merged solo."""
+    stages, params = _model()
+    w1 = _adapter(7)
+    eng = InferenceEngine(stages, CFG, n_slots=2,
+                          adapters=AdapterStore(CFG, 2, 2))
+    eng.register_adapter("t1", w1)
+    r1 = eng.submit(_prompt(5, 31), max_new_tokens=8, seed=41, adapter="t1")
+    r2 = eng.submit(_prompt(7, 32), max_new_tokens=6, seed=42)
+    for _ in range(3):
+        eng.step()
+    eng.preempt(r1.rid)
+    eng.drain()
+    assert r1.n_preempted == 1
+    np.testing.assert_array_equal(
+        r1.tokens, _solo(stages, lora.merge_adapter(params, w1),
+                         r1.prompt, 8, 41))
+    np.testing.assert_array_equal(
+        r2.tokens, _solo(stages, params, r2.prompt, 6, 42))
+
+
+def test_hot_swap_takes_effect_next_admission_not_inflight():
+    """Tick-boundary hot-swap semantics: a request decoding when its
+    tenant is re-registered finishes on the OLD weights (its retained
+    row); a request admitted after the swap decodes the NEW weights —
+    both bit-exact vs their respective merged solos."""
+    stages, params = _model()
+    old_w, new_w = _adapter(8), _adapter(9)
+    eng = InferenceEngine(stages, CFG, n_slots=2,
+                          adapters=AdapterStore(CFG, 2, 2))
+    eng.register_adapter("t1", old_w)
+    r_old = eng.submit(_prompt(5, 33), max_new_tokens=8, seed=51,
+                       adapter="t1")
+    for _ in range(3):
+        eng.step()
+    eng.register_adapter("t1", new_w)          # swap under load
+    r_new = eng.submit(_prompt(4, 34), max_new_tokens=6, seed=52,
+                       adapter="t1")
+    eng.drain()
+    np.testing.assert_array_equal(
+        r_old.tokens, _solo(stages, lora.merge_adapter(params, old_w),
+                            r_old.prompt, 8, 51))
+    np.testing.assert_array_equal(
+        r_new.tokens, _solo(stages, lora.merge_adapter(params, new_w),
+                            r_new.prompt, 6, 52))
+
+
+# ---------------------------------------------------------------------------
+# journal grammar + crash recovery
+
+
+def test_journal_adp_roundtrip_and_pre_adapter_journals_read_as_base(
+        tmp_path):
+    """``adp`` rides submit records only when a tenant is named; a
+    journal written BEFORE the adapter subsystem existed (no ``adp`` key
+    anywhere) recovers every request onto the base model — the regression
+    pin for old journals."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, sync=False)
+    j.log_submit(rid=0, prompt=[1, 2, 3], max_new=4, temp=0.0, top_k=None,
+                 top_p=None, eos=None, seed=7, cls=None, prio=0,
+                 ttft_dl=None, dl=None, t=0.0)          # pre-adapter shape
+    j.log_submit(rid=1, prompt=[4, 5], max_new=3, temp=0.0, top_k=None,
+                 top_p=None, eos=None, seed=8, cls=None, prio=0,
+                 ttft_dl=None, dl=None, t=0.0, adapter="t9")
+    j.close()
+    with open(path) as f:
+        base_line, tenant_line = f.read().splitlines()
+    assert "adp" not in base_line and '"adp":"t9"' in tenant_line
+    events, valid = read_journal(path)
+    state = recover_state(events[:valid])
+    assert state[0].adapter is None
+    assert state[1].adapter == "t9"
+
+
+def test_crash_recovery_readmits_onto_correct_adapter(tmp_path):
+    """An engine crash mid-flight with mixed tenants: the rebuilt engine
+    (fresh AdapterStore over the supervisor's shared host dict) re-admits
+    every journaled request onto ITS adapter, and all streams equal the
+    uninterrupted run's — which equal the merged solos."""
+    stages, params = _model()
+    w1, w2 = _adapter(1), _adapter(2)
+    specs = [
+        dict(prompt=_prompt(5, 1), max_new_tokens=8, seed=11, adapter="t1"),
+        dict(prompt=_prompt(9, 2), max_new_tokens=6, seed=12,
+             temperature=0.8, top_k=5),
+        dict(prompt=_prompt(3, 3), max_new_tokens=7, seed=13, adapter="t2"),
+        dict(prompt=_prompt(7, 4), max_new_tokens=5, seed=14, adapter="t1",
+             temperature=1.1, top_k=4),
+    ]
+
+    def run(name, chaos):
+        if chaos:
+            faults.install(faults.FaultPlan.parse(chaos))
+        sup = ServeSupervisor(
+            engine_factory(stages, CFG, n_slots=2, block_size=4,
+                           prefill_chunk=3, adapter_rank=2),
+            str(tmp_path / name))
+        sup.register_adapter("t1", w1)
+        sup.register_adapter("t2", w2)
+        handles = [sup.submit(**s) for s in specs]
+        sup.drain()
+        sup.close()
+        faults.uninstall()
+        return sup, [list(h.tokens) for h in handles]
+
+    _, base = run("base.jsonl", None)
+    sup, crashed = run("crash.jsonl", "engine-crash@serve.tick=3")
+    assert sup.restarts == 1
+    assert crashed == base
+    merged = {None: params, "t1": lora.merge_adapter(params, w1),
+              "t2": lora.merge_adapter(params, w2)}
+    for toks, s in zip(crashed, specs):
+        np.testing.assert_array_equal(
+            toks, _solo(stages, merged[s.get("adapter")], s["prompt"],
+                        s["max_new_tokens"], s["seed"],
+                        temperature=s.get("temperature", 0.0),
+                        top_k=s.get("top_k")))
+
+
+def test_unknown_adapter_rejected_before_journaling(tmp_path):
+    """An unregistered tenant fails at the admission gate BEFORE the
+    submit record is journaled — a crash-restart must not replay a
+    request the engine can never serve."""
+    stages, _ = _model()
+    sup = ServeSupervisor(
+        engine_factory(stages, CFG, n_slots=2, block_size=4,
+                       prefill_chunk=3, adapter_rank=2),
+        str(tmp_path / "rej.jsonl"))
+    with pytest.raises(KeyError):
+        sup.submit(_prompt(4, 1), max_new_tokens=3, seed=1, adapter="nope")
+    sup.close()
+    events, valid = read_journal(str(tmp_path / "rej.jsonl"))
+    assert [e for e in events[:valid] if e.get("ev") == "submit"] == []
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache isolation
+
+
+def test_prefix_cache_isolated_per_tenant_and_orphaned_on_swap():
+    """The SAME prompt served under t1 must not prefix-hit for t2 or for
+    base (the K/V under a different delta is simply wrong), and a
+    hot-swap of t1 orphans its old version's blocks."""
+    stages, _ = _model()
+    store = AdapterStore(CFG, 2, 2)
+    eng = InferenceEngine(stages, CFG, n_slots=2, block_size=4,
+                          prefill_chunk=None, adapters=store)
+    eng.register_adapter("t1", _adapter(1))
+    eng.register_adapter("t2", _adapter(2))
+    p = _prompt(8, 71)
+    eng.submit(p, max_new_tokens=2, seed=1, adapter="t1")
+    eng.drain()
+    ns1 = store.namespace_of("t1")
+    assert eng.pool.shared_prefix_len(p, ns1) >= 4     # t1 re-use works
+    assert eng.pool.shared_prefix_len(p, store.namespace_of("t2")) == 0
+    assert eng.pool.shared_prefix_len(p, b"") == 0     # base isolated too
+    eng.register_adapter("t1", _adapter(3))            # hot-swap
+    assert eng.pool.shared_prefix_len(p, store.namespace_of("t1")) == 0
+    assert eng.pool.shared_prefix_len(p, ns1) >= 4     # old ns now orphaned
+
+
+# ---------------------------------------------------------------------------
+# fleet routing + pinned scenario
+
+
+def test_affinity_routes_to_adapter_resident_replica(tmp_path):
+    """A fresh prompt (no prefix signal) for tenant t1 routes to the
+    replica already holding t1's bank row, not the round-robin choice —
+    and the adapter-affinity counter records the hit."""
+    stages, _ = _model()
+    metrics = ServeMetrics()
+    fleet = ServeFleet(
+        engine_factory(stages, CFG, n_slots=2, block_size=4,
+                       prefill_chunk=3, adapter_rank=2,
+                       metrics=metrics),
+        os.path.join(str(tmp_path), "aff"), n_replicas=2,
+        journal_sync=False, metrics=metrics, clock=VirtualClock(0.001))
+    fleet.register_adapter("t1", _adapter(1))
+    h0 = fleet.submit(_prompt(8, 81), max_new_tokens=2, seed=1,
+                      adapter="t1")
+    fleet.drain()                    # t1 now resident on h0's home only
+    h1 = fleet.submit(_prompt(6, 82), max_new_tokens=2, seed=2,
+                      adapter="t1")  # fresh prompt: no prefix overlap
+    assert fleet._home[h1.rid] == fleet._home[h0.rid]
+    fleet.drain()
+    fleet.close()
+    assert int(metrics.route_adapter_hits.value) >= 1
+    assert int(metrics.adapter_swaps.value) == 1       # one upload, reused
+
+
+def test_hot_adapter_churn_affinity_beats_round_robin_pinned():
+    """The hot-adapter-churn scenario on both routing policies, exact
+    pinned numbers: affinity keeps each tenant's bank row warm on its
+    home replica (3 uploads — the min_adapter_swaps gate exactly, all
+    forced by the tick-6 hot-swap) while round-robin re-uploads banks
+    across the fleet (7) and never scores an adapter-affinity hit."""
+    stages, _ = _model()
+    aff = run_scenario("hot-adapter-churn", stages, CFG)
+    rr = run_scenario("hot-adapter-churn", stages, CFG, route="round-robin")
+    assert aff["completed"] == rr["completed"] == 18
+    assert aff["slo_ok"] is True
+    assert aff["adapters"]["rank"] == 2
+    assert aff["adapters"]["tenants"] == ["tenant-a", "tenant-b"]
+    assert aff["adapters"]["swaps"] == 3
+    assert aff["adapters"]["adapter_affinity_hits"] == 15
+    assert rr["adapters"]["swaps"] == 7
+    assert rr["adapters"]["adapter_affinity_hits"] == 0
+    assert aff["adapters"]["swaps"] < rr["adapters"]["swaps"]
+
+
+# ---------------------------------------------------------------------------
+# metrics + analyzer parity
+
+
+def test_metrics_sum_swaps_across_stores():
+    """A fleet's replicas share ONE ServeMetrics: the lifetime->delta
+    swap accounting is keyed per store, so two stores' counters SUM
+    instead of ratcheting to the max — and a repeated report of the same
+    lifetime value adds nothing."""
+    m = ServeMetrics()
+    s1 = {"resident_bytes": 2048, "swaps_total": 2, "n_resident": 1,
+          "n_rows": 3, "rank": 2, "store": 101}
+    s2 = dict(s1, swaps_total=3, store=102)
+    m.on_tick(0, 0, 2, adapter_stats=s1)
+    m.on_tick(0, 0, 2, adapter_stats=s2)
+    assert int(m.adapter_swaps.value) == 5
+    m.on_tick(0, 0, 2, adapter_stats=s1)               # no new swaps
+    assert int(m.adapter_swaps.value) == 5
+    m.on_tick(0, 0, 2, adapter_stats=dict(s1, swaps_total=4))
+    assert int(m.adapter_swaps.value) == 7
+
+
+def test_analyzer_predicts_live_adapter_bytes_exactly():
+    """The acceptance pin: ``predict_adapter_bytes`` over the live
+    engine's spec equals the store's own accounting equals the exported
+    gauge — one formula (lora.bank_bytes), zero drift — and the engine's
+    exact programs lint clean with adapters on."""
+    from simple_distributed_machine_learning_tpu.analysis.programs import (
+        engine_spec,
+        lint_engine,
+        predict_adapter_bytes,
+    )
+    stages, _ = _model()
+    metrics = ServeMetrics()
+    store = AdapterStore(CFG, 2, 2)
+    eng = InferenceEngine(stages, CFG, n_slots=2, adapters=store,
+                          metrics=metrics)
+    eng.register_adapter("t1", _adapter(1))
+    eng.submit(_prompt(5, 91), max_new_tokens=3, seed=1, adapter="t1")
+    eng.drain()
+    predicted = predict_adapter_bytes(engine_spec(eng))
+    assert predicted == store.resident_bytes > 0
+    assert predicted == int(metrics.adapter_resident_bytes.value)
+    assert predicted == lora.bank_bytes(3, CFG.n_layers, CFG.d_model, 2)
+    assert lint_engine(eng).ok()

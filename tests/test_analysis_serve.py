@@ -271,7 +271,7 @@ def test_real_builders_are_memoized(stages):
         elif name == "make_cached_decoder":
             def build():
                 return make(stages, CFG, 4, 4)
-        elif name == "make_paged_block_copy":
+        elif name in ("make_paged_block_copy", "make_adapter_bank_update"):
             build = make
         elif "paged" in name:
             def build():

@@ -305,7 +305,7 @@ def test_unknown_scenario_rejected():
                               "overload-shed", "fleet-replica-loss",
                               "hot-prefix-skew", "fleet-autoscale-diurnal",
                               "disagg-prefill-heavy", "offload-churn",
-                              "handoff-replica-loss"}
+                              "handoff-replica-loss", "hot-adapter-churn"}
 
 
 # ---------------------------------------------------------------------------
